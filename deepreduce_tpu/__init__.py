@@ -1,0 +1,39 @@
+"""deepreduce_tpu — a TPU-native sparse-gradient communication framework.
+
+Capabilities mirror DeepReduce (NeurIPS'21, hangxu0304/DeepReduce): sparse
+gradients are decomposed into values and indices, each compressed independently
+or jointly (reference README.md:5), then exchanged between data-parallel
+workers. Where the reference stacks GRACE + Horovod + NCCL allgather and
+CUDA/CuPy/C++-CPU codecs, this framework is built from scratch on JAX:
+
+- static-shape, jit-compiled codecs (`deepreduce_tpu.codecs`)
+- `jax.lax.all_gather` over ICI inside `shard_map` (`deepreduce_tpu.comm`)
+- functional residual error-feedback state (`deepreduce_tpu.memory`)
+- flax model zoo for the reference's benchmark families
+  (`deepreduce_tpu.models`)
+- a C++ native layer for the host-side codec path (`deepreduce_tpu.native`),
+  standing in for the reference's TensorFlow CPU custom ops.
+
+Nothing here is a translation: dynamic-size payloads (the reference's
+`tensors_size_are_same=False` contract, pytorch/deepreduce.py:54-59) become
+fixed-budget payloads with in-band length words so XLA collectives get static
+shapes.
+"""
+
+from deepreduce_tpu import codecs, comm, config, memory, metrics, sparse
+from deepreduce_tpu.config import DeepReduceConfig, from_params
+from deepreduce_tpu.sparse import SparseGrad
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SparseGrad",
+    "DeepReduceConfig",
+    "from_params",
+    "codecs",
+    "comm",
+    "config",
+    "memory",
+    "metrics",
+    "sparse",
+]

@@ -1,0 +1,40 @@
+"""Codec layer: index and value compressors over `SparseGrad`.
+
+Mirrors the reference's `SparseCompressor` registry
+(/root/reference/pytorch/deepreduce.py:913-922) with jit-compatible,
+static-shape codecs. Every codec is a pair of pure functions
+
+    encode(sp, *, cfg, ...) -> payload   (pytree of fixed-shape arrays)
+    decode(payload, *, cfg) -> SparseGrad-like
+
+plus a `wire_bits(payload)` accounting of meaningful (non-padding) bits on
+the wire, the role of GRACE's `tensor_bits` (pytorch/deepreduce.py:93-95).
+"""
+
+from deepreduce_tpu.codecs import (
+    bloom,
+    doubleexp,
+    gzip_codec,
+    huffman,
+    integer,
+    packing,
+    polyfit,
+    qsgd,
+    rle,
+)
+from deepreduce_tpu.codecs.registry import INDEX_CODECS, VALUE_CODECS, get_codec
+
+__all__ = [
+    "bloom",
+    "doubleexp",
+    "gzip_codec",
+    "huffman",
+    "integer",
+    "packing",
+    "polyfit",
+    "qsgd",
+    "rle",
+    "INDEX_CODECS",
+    "VALUE_CODECS",
+    "get_codec",
+]

@@ -1,0 +1,272 @@
+"""Bloom-filter index codec, jit-compiled and table-free.
+
+Reference parity (/root/reference/pytorch/deepreduce.py:431-555 and
+tensorflow/bloom_filter_compression.cc): indices are inserted into a bloom
+filter; only the packed bit-array crosses the wire; both sides re-derive the
+index set by querying the whole universe and running a deterministic
+selection *policy* over the positives. Because false positives shift which
+indices are selected, the encoder is FP-aware: it re-reads values from the
+dense tensor at the *selected* positions (pytorch/deepreduce.py:519-523), so
+receivers scatter true gradient values to exactly the positions they will
+derive.
+
+TPU-first redesign:
+
+- Hashing is computed, not gathered: a murmur3-finalizer integer mix per
+  (index, seed_j) replaces the reference's precomputed ``[18M x H]`` hash
+  table (pytorch/deepreduce.py:461-477) — no 18M-row tensor in HBM, no
+  gather in the hot loop. The C++ native layer implements the identical mix
+  so host and device payloads interoperate.
+- Filter geometry follows the C++ op's optimal-m form
+  (bloom_filter_compression.cc:85-99, SURVEY.md §2.6): ``m_bytes =
+  ceil(k·|ln fpr| / ln²2 / 8)`` rounded up to 8-byte alignment,
+  ``h = ceil((8·m_bytes/k)·ln 2)``; default FPR ``0.1·k/d``
+  (pytorch/deepreduce.py:511).
+- Policies ``leftmost`` / ``random`` / ``p0`` (pytorch/deepreduce.py:479-492)
+  are mask+cumsum prefix selections — sort-free, static-shape. ``random`` is
+  keyed by (seed, step) on *both* sides, fixing the reference's re-seeded
+  ``manual_seed(42)`` quirk while keeping its cross-worker determinism
+  contract (policies.hpp:160-180 seeds by step). ``conflict_sets`` (P2) is
+  native-only, as in the reference (policies.hpp:43-146) — see
+  `deepreduce_tpu.native`.
+- P0's data-dependent output size (|P| >= k) becomes a static budget from
+  the paper's Lemma-6 expectation ``|P| <= k + fpr·(d-k)`` with 5% + 64
+  headroom; `nsel` is the in-band length word (the reference prepends the
+  true count, pytorch/deepreduce.py:525-527).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu.codecs import packing
+from deepreduce_tpu.sparse import SparseGrad
+
+_LN2 = 0.6931471805599453
+_GOLDEN = 0x9E3779B9
+_QUERY_CHUNK = 1 << 16
+
+
+def fmix32(x: jax.Array) -> jax.Array:
+    """murmur3 32-bit finalizer (same constants as MurmurHash3_fmix32)."""
+    x = jnp.asarray(x, jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_seeds(num_hash: int) -> jax.Array:
+    """Per-hash-function seeds, derived — not stored (uint32[h])."""
+    j = jnp.arange(1, num_hash + 1, dtype=jnp.uint32)
+    return fmix32(j * jnp.uint32(_GOLDEN))
+
+
+def hash_positions(indices: jax.Array, seeds: jax.Array, m_bits: int) -> jax.Array:
+    """Bit positions [..., h] for each index."""
+    idx = jnp.asarray(indices, jnp.uint32)
+    return (fmix32(idx[..., None] ^ seeds) % jnp.uint32(m_bits)).astype(jnp.int32)
+
+
+def bloom_config(k: int, d: int, fpr: Optional[float]) -> Tuple[int, int, float]:
+    """(m_bits, num_hash, fpr) — static geometry from static (k, d)."""
+    if fpr is None:
+        fpr = 0.1 * k / d  # pytorch/deepreduce.py:511
+    m_bytes = int(math.ceil(k * abs(math.log(fpr)) / (_LN2 * _LN2) / 8.0))
+    m_bytes = max(8, (m_bytes + 7) // 8 * 8)  # 8-byte aligned, as the C++ op intends
+    num_hash = max(1, int(math.ceil((m_bytes * 8.0 / k) * _LN2)))
+    return m_bytes * 8, num_hash, fpr
+
+
+def p0_budget(k: int, d: int, fpr: float) -> int:
+    """Static slot budget for policy p0 (all positives): Lemma-6 expectation
+    plus headroom (SURVEY.md §7 hard part 1)."""
+    return min(d, int(math.ceil(k + 1.05 * fpr * (d - k))) + 64)
+
+
+def policy_budget(policy: str, k: int, d: int, fpr: float) -> int:
+    return p0_budget(k, d, fpr) if policy == "p0" else k
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomMeta:
+    """Static codec geometry, shared by encode and decode."""
+
+    d: int
+    k: int
+    m_bits: int
+    num_hash: int
+    fpr: float
+    policy: str
+    budget: int
+
+    @staticmethod
+    def create(k: int, d: int, fpr: Optional[float] = None, policy: str = "leftmost") -> "BloomMeta":
+        if policy == "conflict_sets":
+            raise NotImplementedError(
+                "conflict_sets (P2) is native-only, as in the reference "
+                "(policies.hpp:43-146); use deepreduce_tpu.native.bloom"
+            )
+        m_bits, num_hash, fpr_eff = bloom_config(k, d, fpr)
+        return BloomMeta(
+            d=d,
+            k=k,
+            m_bits=m_bits,
+            num_hash=num_hash,
+            fpr=fpr_eff,
+            policy=policy,
+            budget=policy_budget(policy, k, d, fpr_eff),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BloomPayload:
+    values: jax.Array  # f32[budget] — values at the selected positions
+    words: jax.Array  # uint32[m_bits/32] — packed filter bit-array
+    nsel: jax.Array  # i32[] — live selected count (p0 count prefix role)
+
+
+def insert(indices: jax.Array, nnz: jax.Array, meta: BloomMeta) -> jax.Array:
+    """Build the packed filter from (possibly padded) indices.
+
+    Dead slots are re-pointed at the first index — inserting a duplicate is a
+    no-op under bloom set semantics, which keeps the scatter static-shape.
+    """
+    live = jnp.arange(indices.shape[0], dtype=jnp.int32) < nnz
+    idx = jnp.where(live, indices, indices[0])
+    seeds = hash_seeds(meta.num_hash)
+    pos = hash_positions(idx, seeds, meta.m_bits).reshape(-1)
+    bits = jnp.zeros((meta.m_bits,), jnp.uint8).at[pos].max(jnp.uint8(1))
+    return packing.pack_bitmap(bits)
+
+
+def query_universe(words: jax.Array, meta: BloomMeta) -> jax.Array:
+    """bool[d]: membership test for every index in the universe — the hot op
+    (pytorch/deepreduce.py:466-477), chunked so the [chunk, h] position block
+    stays small regardless of d."""
+    seeds = hash_seeds(meta.num_hash)
+    d = meta.d
+    chunk = min(_QUERY_CHUNK, max(1, d))
+    n_chunks = (d + chunk - 1) // chunk
+
+    def one_chunk(c: jax.Array) -> jax.Array:
+        idx = c * chunk + jnp.arange(chunk, dtype=jnp.int32)
+        pos = hash_positions(idx, seeds, meta.m_bits)
+        w = words[pos // 32]
+        bit = (w >> (pos % 32).astype(jnp.uint32)) & jnp.uint32(1)
+        hit = jnp.min(bit, axis=-1) == 1
+        return jnp.logical_and(hit, idx < d)
+
+    if n_chunks == 1:
+        return one_chunk(jnp.int32(0))[:d]
+    mask = jax.lax.map(one_chunk, jnp.arange(n_chunks, dtype=jnp.int32))
+    return mask.reshape(-1)[:d]
+
+
+def _prefix_select(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Array]:
+    """First `budget` True positions of `mask`, ascending, via cumsum ranks
+    (sort-free). Returns (indices[budget], count)."""
+    d = mask.shape[0]
+    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    take = jnp.logical_and(mask, rank < budget)
+    out = (
+        jnp.zeros((budget,), jnp.int32)
+        .at[jnp.where(take, rank, budget)]
+        .max(jnp.where(take, jnp.arange(d, dtype=jnp.int32), 0), mode="drop")
+    )
+    count = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), budget)
+    return out, count
+
+
+def select(
+    mask: jax.Array, meta: BloomMeta, *, step: jax.Array, seed: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    """Run the selection policy over the positive mask. Deterministic given
+    (mask, step, seed) — the encode/decode agreement contract
+    (bloom_filter_compression.cc:217-218)."""
+    if meta.policy in ("leftmost", "p0"):
+        return _prefix_select(mask, meta.budget)
+    if meta.policy == "random":
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), jnp.asarray(step, jnp.uint32))
+        pri = jax.random.uniform(key, mask.shape)
+        pri = jnp.where(mask, pri, -1.0)
+        _, chosen = jax.lax.top_k(pri, meta.budget)
+        count = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), meta.budget)
+        # fewer positives than budget: slots whose priority was -1 are dead —
+        # push them past the live ones, emit canonical ascending order
+        valid = mask[chosen]
+        order = jnp.argsort(jnp.where(valid, chosen, meta.d))
+        chosen = chosen[order]
+        live = jnp.arange(meta.budget, dtype=jnp.int32) < count
+        return jnp.where(live, chosen, 0).astype(jnp.int32), count
+    raise ValueError(f"unknown policy {meta.policy!r}")
+
+
+def encode(
+    sp: SparseGrad,
+    dense: Optional[jax.Array],
+    meta: BloomMeta,
+    *,
+    step: jax.Array = 0,
+    seed: int = 0,
+) -> BloomPayload:
+    """Insert + FP-aware value re-read (pytorch/deepreduce.py:505-533)."""
+    words = insert(sp.indices, sp.nnz, meta)
+    if dense is not None:
+        mask = query_universe(words, meta)
+        selected, nsel = select(mask, meta, step=step, seed=seed)
+        flat = dense.reshape(-1)
+        live = jnp.arange(meta.budget, dtype=jnp.int32) < nsel
+        values = jnp.where(live, flat[selected], 0.0)
+    else:
+        # no dense tensor: transmit sparsifier values as-is (the reference's
+        # non-fp-aware branch); only sensible when decode-side selection
+        # happens to align (fpr ~ 0)
+        values = jnp.zeros((meta.budget,), sp.values.dtype).at[: sp.k].set(sp.values)
+        nsel = jnp.minimum(sp.nnz, meta.budget)
+    return BloomPayload(values=values, words=words, nsel=nsel.astype(jnp.int32))
+
+
+def decode(
+    payload: BloomPayload,
+    meta: BloomMeta,
+    shape: Tuple[int, ...],
+    *,
+    step: jax.Array = 0,
+    seed: int = 0,
+) -> SparseGrad:
+    """Query the universe, re-run the policy, pair with transmitted values
+    (pytorch/deepreduce.py:535-555)."""
+    mask = query_universe(payload.words, meta)
+    selected, nsel = select(mask, meta, step=step, seed=seed)
+    nsel = jnp.minimum(nsel, payload.nsel)
+    return SparseGrad(
+        values=payload.values,
+        indices=selected,
+        nnz=nsel.astype(jnp.int32),
+        shape=shape,
+    )
+
+
+def wire_bits(payload: BloomPayload, meta: BloomMeta) -> jax.Array:
+    """Filter bits + selected values + count word (the C++ wire format
+    ``[m | h | values | bit-array]``, bloom_filter_compression.cc:112-141)."""
+    return jnp.asarray(64 + meta.m_bits, jnp.int64) + payload.nsel.astype(jnp.int64) * 32
+
+
+def measured_fpr(sp: SparseGrad, words: jax.Array, meta: BloomMeta) -> jax.Array:
+    """Observed false-positive rate — the `Compute_False_Positives` diagnostic
+    (compression_utils.hpp:137-148)."""
+    mask = query_universe(words, meta)
+    truth = jnp.zeros((meta.d,), jnp.bool_).at[sp.indices].set(True)
+    fp = jnp.sum(jnp.logical_and(mask, ~truth).astype(jnp.int32))
+    return fp / jnp.maximum(1, meta.d - sp.nnz)

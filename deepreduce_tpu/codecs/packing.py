@@ -1,0 +1,115 @@
+"""Bit-packing with dynamic width into a static word budget.
+
+The reference packs integers at runtime-chosen bit widths with CuPy
+``packbits`` (/root/reference/pytorch/deepreduce.py:193-248: header
+``[N×4 bytes | bits×1 byte | body | bit-planes]``) and its `both` mode packs
+3×21-bit values per int64 (:165-191). Neither survives jit: output size
+depends on data. TPU-native version: the caller supplies a static word
+budget (worst case ``ceil(n * max_width / 32)``); the packed stream carries
+``(words, n, width)`` and padding words are zero. `wire_bits` reports the
+meaningful payload ``n * width`` so compression metrics see the true size
+even though the allgather buffer is budget-shaped.
+
+Bit order: value `i`'s bit `b` (LSB-first) lands at stream position
+``i*width + b``; stream bit `p` lives in word ``p // 32`` at bit ``p % 32``.
+The C++ native layer (`deepreduce_tpu/native`) implements the identical
+layout so payloads are exchangeable across the JAX and host paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PackedInts:
+    words: jax.Array  # uint32[budget_words]
+    count: jax.Array  # i32[] — number of packed values
+    width: jax.Array  # i32[] — bits per value (1..32)
+
+
+def bits_needed(max_val: jax.Array) -> jax.Array:
+    """Exact ceil(log2(max_val+1)), in integer arithmetic (float log2 is
+    off-by-one near powers of two). Returns >= 1."""
+    max_val = jnp.asarray(max_val, jnp.uint32)
+    width = jnp.int32(1)
+    for j in range(1, 32):
+        width = width + (max_val >= jnp.uint32(1) << j).astype(jnp.int32)
+    return width
+
+
+def budget_words(n: int, max_width: int = 32) -> int:
+    """Static word budget for packing `n` values at up to `max_width` bits."""
+    return (n * max_width + 31) // 32
+
+
+def pack(
+    values: jax.Array, width: jax.Array, *, max_width: int = 32, n_budget_words: int | None = None
+) -> PackedInts:
+    """Pack uint values at `width` bits each (dynamic) into uint32 words
+    (static budget). Values must fit in `width` bits; higher bits dropped."""
+    values = values.astype(jnp.uint32)
+    n = values.shape[0]
+    nw = budget_words(n, max_width) if n_budget_words is None else n_budget_words
+    width = jnp.asarray(width, jnp.int32)
+
+    b = jnp.arange(max_width, dtype=jnp.int32)  # candidate bit lanes
+    # bit (i, b) of the stream
+    bits = (values[:, None] >> b[None, :].astype(jnp.uint32)) & jnp.uint32(1)
+    live = b[None, :] < width
+    pos = jnp.arange(n, dtype=jnp.int32)[:, None] * width + b[None, :]
+    pos = jnp.where(live, pos, nw * 32)  # dead lanes dropped by scatter mode
+    word_idx = pos // 32
+    bit_idx = (pos % 32).astype(jnp.uint32)
+    contrib = jnp.where(live, bits.astype(jnp.uint32) << bit_idx, jnp.uint32(0))
+    # every live (word, bit) pair is unique, so scatter-add == bitwise OR
+    words = (
+        jnp.zeros((nw,), jnp.uint32)
+        .at[word_idx.reshape(-1)]
+        .add(contrib.reshape(-1), mode="drop")
+    )
+    return PackedInts(words=words, count=jnp.asarray(n, jnp.int32), width=width)
+
+
+def unpack(packed: PackedInts, n: int, *, max_width: int = 32) -> jax.Array:
+    """Inverse of `pack`; `n` is the static value count (== packing budget)."""
+    width = packed.width
+    b = jnp.arange(max_width, dtype=jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)[:, None] * width + b[None, :]
+    word = packed.words[jnp.clip(pos // 32, 0, packed.words.shape[0] - 1)]
+    bit = (word >> (pos % 32).astype(jnp.uint32)) & jnp.uint32(1)
+    live = b[None, :] < width
+    vals = jnp.sum(
+        jnp.where(live, bit << b[None, :].astype(jnp.uint32), jnp.uint32(0)), axis=1
+    ).astype(jnp.uint32)
+    live_vals = jnp.arange(n, dtype=jnp.int32) < packed.count
+    return jnp.where(live_vals, vals, 0)
+
+
+def wire_bits(packed: PackedInts) -> jax.Array:
+    """Meaningful bits on the wire: header (count word + width byte, as in the
+    reference's 5-byte header, pytorch/deepreduce.py:216-218) + n*width."""
+    return 40 + packed.count * packed.width
+
+
+def pack_bitmap(bits_u8: jax.Array) -> jax.Array:
+    """uint8 0/1 array [m] -> uint32 words [ceil(m/32)], LSB-first (the CuPy
+    ``packbits`` role, pytorch/deepreduce.py:446-450)."""
+    m = bits_u8.shape[0]
+    nw = (m + 31) // 32
+    padded = jnp.zeros((nw * 32,), jnp.uint32).at[: m].set(bits_u8.astype(jnp.uint32))
+    lanes = padded.reshape(nw, 32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(lanes << shifts[None, :], axis=1).astype(jnp.uint32)
+
+
+def unpack_bitmap(words: jax.Array, m: int) -> jax.Array:
+    """uint32 words -> uint8 0/1 array [m]."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[:, None] >> shifts[None, :]) & jnp.uint32(1)
+    return bits.reshape(-1)[:m].astype(jnp.uint8)

@@ -1,0 +1,151 @@
+"""Segmented polynomial curve-fit value codec (PolyFit).
+
+Reference (/root/reference/pytorch/deepreduce.py:305-425): sort kept values
+descending, split into geometric segments whose sizes derive from ``(N,
+num_pos)`` — ratios {1/5 … 1/100000} gated at >30 elements, split at the
+positive/negative boundary (get_segments :362-377) — then per-segment
+degree-5 least squares in float64 with a CPU matrix inverse
+(LeastSquares :326-338). Only the coefficients and the value-sorted indices
+cross the wire; the receiver re-derives the segment structure from
+``(N, num_pos)`` and evaluates (:411-425).
+
+TPU-first redesign (same wire semantics, static shapes, no f64):
+
+- The segment *count* is fixed at ``2·len(ratios) + 2``; inactive segments
+  have zero length. Segment sizes stay a traced function of the traced
+  ``num_pos``, so per-worker structure still differs (the reason the
+  reference sets ``tensors_size_are_same=False`` :364-367) while every array
+  shape is static.
+- One masked pass builds all normal equations at once: per-element Legendre
+  basis rows + `segment_sum` -> [S, 6, 6] systems, batched `linalg.solve`.
+  No CPU round-trip (the reference's :330-334 workaround), no f64: fitting
+  in a shifted-Legendre basis on the normalized segment domain keeps the
+  normal matrix near-orthogonal (condition O(10) instead of the Vandermonde
+  ~1e7), which is what made the reference need float64 in the first place.
+- Coefficients travel as f32 (the reference sends f64 — half the bits for
+  the same fitted curve within f32 noise).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu.sparse import SparseGrad
+
+RATIOS = (1 / 5, 1 / 10, 1 / 30, 1 / 100, 1 / 300, 1 / 1000, 1 / 3000, 1 / 10000, 1 / 30000, 1 / 100000)
+MIN_SEGMENT = 30  # reference's >30 gate (pytorch/deepreduce.py:371-374)
+
+
+@dataclasses.dataclass(frozen=True)
+class PolyFitMeta:
+    k: int
+    degree: int = 5  # params['poly_degree'] default (pytorch/deepreduce.py:385)
+    sort: bool = False  # params['sort']: True = values arrive pre-ordered
+
+    @property
+    def num_segments(self) -> int:
+        return 2 * len(RATIOS) + 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PolyFitPayload:
+    coeffs: jax.Array  # f32[S, degree+1], Legendre basis per segment
+    num_pos: jax.Array  # i32[] — the receiver's key to the segment structure
+    indices: jax.Array  # i32[k] — indices in value-sorted order (the mapping)
+
+
+def segment_sizes(k: int, num_pos: jax.Array) -> jax.Array:
+    """i32[S] segment lengths along the descending-sorted value curve:
+    fine→coarse positive segments, positive remainder, negative remainder,
+    coarse→fine negative segments (get_segments, pytorch/deepreduce.py:362-377).
+    Inactive ratio slots are zero-length."""
+    num_pos = jnp.asarray(num_pos, jnp.int32)
+    num_neg = jnp.int32(k) - num_pos
+    r = jnp.asarray(RATIOS, jnp.float32)
+    pos = jnp.floor(num_pos.astype(jnp.float32) * r).astype(jnp.int32)
+    neg = jnp.floor(num_neg.astype(jnp.float32) * r).astype(jnp.int32)
+    pos = jnp.where(pos > MIN_SEGMENT, pos, 0)
+    neg = jnp.where(neg > MIN_SEGMENT, neg, 0)
+    rem_pos = num_pos - jnp.sum(pos)
+    rem_neg = num_neg - jnp.sum(neg)
+    return jnp.concatenate([pos[::-1], rem_pos[None], rem_neg[None], neg])
+
+
+def _boundaries(sizes: jax.Array) -> jax.Array:
+    return jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(sizes)])
+
+
+def _legendre_basis(t: jax.Array, degree: int) -> jax.Array:
+    """Shifted-Legendre rows P_0..P_degree at t in [-1, 1]; shape [..., degree+1]."""
+    cols = [jnp.ones_like(t), t]
+    for m in range(1, degree):
+        cols.append(((2 * m + 1) * t * cols[m] - m * cols[m - 1]) / (m + 1))
+    return jnp.stack(cols[: degree + 1], axis=-1)
+
+
+def _element_basis(k: int, sizes: jax.Array, degree: int) -> Tuple[jax.Array, jax.Array]:
+    """Per sorted position i: its segment id and Legendre basis row, from the
+    traced segment sizes. x_local = 1..n within the segment (the reference's
+    1-based arange, GetInputMatrix_Polynomial :313), normalized to (-1, 1]."""
+    bounds = _boundaries(sizes)
+    i = jnp.arange(k, dtype=jnp.int32)
+    seg_id = jnp.searchsorted(bounds[1:], i, side="right").astype(jnp.int32)
+    seg_id = jnp.clip(seg_id, 0, sizes.shape[0] - 1)
+    start = bounds[seg_id]
+    n = jnp.maximum(sizes[seg_id], 1)
+    x_local = (i - start + 1).astype(jnp.float32)
+    t = 2.0 * x_local / n.astype(jnp.float32) - 1.0
+    return seg_id, _legendre_basis(t, degree)
+
+
+def encode(sp: SparseGrad, meta: PolyFitMeta) -> PolyFitPayload:
+    """Sort descending (recording the mapping), fit every segment in one
+    masked batched solve (pytorch/deepreduce.py:382-409 semantics)."""
+    vals, idxs = sp.values, sp.indices
+    if not meta.sort:
+        order = jnp.argsort(-vals)
+        vals = vals[order]
+        idxs = idxs[order]
+    num_pos = jnp.sum((vals > 0.0).astype(jnp.int32))
+
+    sizes = segment_sizes(meta.k, num_pos)
+    seg_id, phi = _element_basis(meta.k, sizes, meta.degree)
+
+    s = meta.num_segments
+    outer = phi[:, :, None] * phi[:, None, :]  # [k, p, p]
+    a = jax.ops.segment_sum(outer, seg_id, num_segments=s)  # [S, p, p]
+    b = jax.ops.segment_sum(phi * vals[:, None], seg_id, num_segments=s)  # [S, p]
+    # Tikhonov jitter keeps zero-length segments solvable (coeffs ~ 0, never
+    # evaluated) without perturbing active ones.
+    p = meta.degree + 1
+    eye = jnp.eye(p, dtype=jnp.float32)
+    tr = jnp.trace(a, axis1=-2, axis2=-1)[:, None, None]
+    coeffs = jnp.linalg.solve(a + (1e-6 * tr / p + 1e-12) * eye, b[..., None])[..., 0]
+    return PolyFitPayload(coeffs=coeffs, num_pos=num_pos, indices=idxs.astype(jnp.int32))
+
+
+def decode(payload: PolyFitPayload, meta: PolyFitMeta, shape: Tuple[int, ...]) -> SparseGrad:
+    """Re-derive segments from (k, num_pos), evaluate the per-segment
+    polynomials (pytorch/deepreduce.py:411-425)."""
+    sizes = segment_sizes(meta.k, payload.num_pos)
+    seg_id, phi = _element_basis(meta.k, sizes, meta.degree)
+    vals = jnp.sum(phi * payload.coeffs[seg_id], axis=-1)
+    return SparseGrad(
+        values=vals.astype(jnp.float32),
+        indices=payload.indices,
+        nnz=jnp.asarray(meta.k, jnp.int32),
+        shape=shape,
+    )
+
+
+def wire_bits(payload: PolyFitPayload, meta: PolyFitMeta) -> jax.Array:
+    """Only active segments' coefficients count (+32 for num_pos, the
+    reference's appended coefficient :405); the [S, p] buffer is padding."""
+    sizes = segment_sizes(meta.k, payload.num_pos)
+    active = jnp.sum((sizes > 0).astype(jnp.int64))
+    return active * (meta.degree + 1) * 32 + 32

@@ -1,0 +1,346 @@
+"""Uniform codec interface + registry.
+
+The reference keys codecs by name in a module dict
+(/root/reference/pytorch/deepreduce.py:913-922). Same here, but each entry
+is a small adapter class binding the static geometry (`meta`) at
+construction — shapes are frozen per (k, d) pair, which is what makes every
+codec jit-stable. Interface:
+
+    codec = get_codec('bloom', kind='index')(k=..., d=..., params={...})
+    payload = codec.encode(sp, dense=dense, step=step, key=key)
+    sp2     = codec.decode(payload, shape, step=step)
+    codec.index_wire_bits(payload), codec.value_wire_bits(payload)
+
+`index_wire_bits` / `value_wire_bits` mirror the reference's split
+idx/val relative-volume accounting (pytorch/deepreduce.py:93-95,148-150).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu.codecs import (
+    bloom,
+    doubleexp,
+    gzip_codec,
+    huffman,
+    integer,
+    polyfit,
+    qsgd,
+    rle,
+)
+from deepreduce_tpu.sparse import SparseGrad
+
+
+class Codec:
+    """Base adapter. Subclasses set kind/order_preserving/fixed_size and
+    implement encode/decode/wire-bit accessors."""
+
+    kind: str = ""
+    order_preserving: bool = False
+    fixed_size: bool = True  # all payloads are static-shape; False only marks
+    # codecs whose *meaningful* size varies per worker
+    # (the reference's tensors_size_are_same contract)
+
+    def __init__(self, k: int, d: int, params: Optional[Dict[str, Any]] = None):
+        self.k = k
+        self.d = d
+        self.params = dict(params or {})
+
+    def encode(self, sp: SparseGrad, dense=None, *, step=0, key=None):
+        raise NotImplementedError
+
+    def decode(self, payload, shape: Tuple[int, ...], *, step=0) -> SparseGrad:
+        raise NotImplementedError
+
+    def index_wire_bits(self, payload) -> jax.Array:
+        raise NotImplementedError
+
+    def value_wire_bits(self, payload) -> jax.Array:
+        raise NotImplementedError
+
+    # -- 'both'-mode composition hooks (value codecs only) ---------------- #
+    # In 'both' mode the value codec runs over the index codec's selection
+    # with arange indices; its within-selection index field (the `mapping`,
+    # pytorch/deepreduce.py:263) is stripped here so the wrapper can bit-pack
+    # it at ceil(log2 k) bits, and restored before decode.
+
+    def both_mapping_max(self) -> int:
+        """Static max value of the stripped mapping; 0 = no mapping."""
+        return self.k - 1
+
+    def strip_for_both(self, payload):
+        """-> (stripped_payload, mapping_uint32 | None, mapping_max)."""
+        import dataclasses as _dc
+
+        mapping = payload.indices.astype(jnp.uint32)
+        stripped = _dc.replace(payload, indices=jnp.zeros((0,), jnp.int32))
+        return stripped, mapping, self.both_mapping_max()
+
+    def restore_for_both(self, stripped, mapping):
+        import dataclasses as _dc
+
+        n = self.k
+        if mapping is None:
+            idx = jnp.arange(n, dtype=jnp.int32)
+        else:
+            idx = mapping.astype(jnp.int32)
+        return _dc.replace(stripped, indices=idx)
+
+
+def _raw_value_bits(n) -> jax.Array:
+    return jnp.asarray(n, jnp.int64) * 32
+
+
+class BloomCodec(Codec):
+    kind = "index"
+    order_preserving = False
+    fixed_size = True  # static budget; p0's live size rides the nsel word
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = bloom.BloomMeta.create(
+            k, d, fpr=self.params.get("fpr"), policy=self.params.get("policy", "leftmost")
+        )
+        self.seed = int(self.params.get("seed", 0))
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return bloom.encode(sp, dense, self.meta, step=step, seed=self.seed)
+
+    def decode(self, payload, shape, *, step=0):
+        return bloom.decode(payload, self.meta, shape, step=step, seed=self.seed)
+
+    def index_wire_bits(self, payload):
+        return jnp.asarray(64 + self.meta.m_bits, jnp.int64)
+
+    def value_wire_bits(self, payload):
+        return payload.nsel.astype(jnp.int64) * 32
+
+
+class RLECodec(Codec):
+    kind = "index"
+    order_preserving = False
+    fixed_size = False
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = rle.RLEMeta(k=k, d=d)
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return rle.encode(sp, self.meta)
+
+    def decode(self, payload, shape, *, step=0):
+        return rle.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return rle.wire_bits(payload, self.meta)
+
+    def value_wire_bits(self, payload):
+        return _raw_value_bits(payload.nnz)
+
+
+class IntegerCodec(Codec):
+    kind = "index"
+    order_preserving = False  # sorts ascending, like the reference RLE
+    fixed_size = False
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = integer.IntegerMeta(k=k, d=d)
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return integer.encode(sp, self.meta)
+
+    def decode(self, payload, shape, *, step=0):
+        return integer.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return integer.wire_bits(payload, self.meta)
+
+    def value_wire_bits(self, payload):
+        return _raw_value_bits(payload.nnz)
+
+
+class HuffmanCodec(Codec):
+    kind = "index"
+    order_preserving = True
+    fixed_size = False
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = huffman.HuffmanMeta(k=k, d=d)
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return huffman.encode(sp, self.meta)
+
+    def decode(self, payload, shape, *, step=0):
+        return huffman.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return huffman.wire_bits(payload, self.meta)
+
+    def value_wire_bits(self, payload):
+        return _raw_value_bits(payload.nnz)
+
+
+class PolyFitCodec(Codec):
+    kind = "value"
+    order_preserving = False
+    fixed_size = True  # the reference's one tensors_size_are_same=True value
+    # codec on the PyTorch path (pytorch/deepreduce.py:57-59)
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = polyfit.PolyFitMeta(
+            k=k,
+            degree=int(self.params.get("poly_degree", 5)),
+            sort=bool(self.params.get("sort", False)),
+        )
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return polyfit.encode(sp, self.meta)
+
+    def decode(self, payload, shape, *, step=0):
+        return polyfit.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return _raw_value_bits(self.k)  # indices travel raw in value-only mode
+
+    def value_wire_bits(self, payload):
+        return polyfit.wire_bits(payload, self.meta)
+
+
+class DoubleExpCodec(Codec):
+    kind = "value"
+    order_preserving = False
+    fixed_size = True
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = doubleexp.DoubleExpMeta(k=k)
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return doubleexp.encode(sp, self.meta)
+
+    def decode(self, payload, shape, *, step=0):
+        return doubleexp.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return _raw_value_bits(self.k)
+
+    def value_wire_bits(self, payload):
+        return doubleexp.wire_bits(payload, self.meta)
+
+    def both_mapping_max(self) -> int:
+        return 2 * self.k
+
+    def strip_for_both(self, payload):
+        import dataclasses as _dc
+
+        # signed indices carry sign info: shift to [0, 2k] so they pack as uints
+        mapping = (payload.signed_indices + self.k).astype(jnp.uint32)
+        stripped = _dc.replace(payload, signed_indices=jnp.zeros((0,), jnp.int32))
+        return stripped, mapping, self.both_mapping_max()
+
+    def restore_for_both(self, stripped, mapping):
+        import dataclasses as _dc
+
+        if mapping is None:
+            signed = jnp.arange(1, self.k + 1, dtype=jnp.int32)
+        else:
+            signed = mapping.astype(jnp.int32) - self.k
+        return _dc.replace(stripped, signed_indices=signed)
+
+
+class QSGDCodec(Codec):
+    kind = "value"
+    order_preserving = True
+    fixed_size = True
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = qsgd.QSGDMeta(
+            k=k,
+            quantum_num=int(self.params.get("quantum_num", 127)),
+            bucket_size=int(self.params.get("bucket_size", 512)),
+        )
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        if key is None:
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(int(self.params.get("seed", 0))), jnp.asarray(step, jnp.uint32)
+            )
+        return qsgd.encode(sp, self.meta, key)
+
+    def decode(self, payload, shape, *, step=0):
+        return qsgd.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return _raw_value_bits(self.k)
+
+    def value_wire_bits(self, payload):
+        return qsgd.wire_bits(payload, self.meta)
+
+    def both_mapping_max(self) -> int:
+        return 0
+
+    def strip_for_both(self, payload):
+        import dataclasses as _dc
+
+        # order-preserving: the mapping is the identity — elide it
+        return _dc.replace(payload, indices=jnp.zeros((0,), jnp.int32)), None, 0
+
+
+class GzipCodec(Codec):
+    kind = "value"
+    order_preserving = True
+    fixed_size = False
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = gzip_codec.GzipMeta(k=k)
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return gzip_codec.encode(sp, self.meta)
+
+    def decode(self, payload, shape, *, step=0):
+        return gzip_codec.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return _raw_value_bits(self.k)
+
+    def value_wire_bits(self, payload):
+        return gzip_codec.wire_bits(payload, self.meta)
+
+    def both_mapping_max(self) -> int:
+        return 0
+
+    def strip_for_both(self, payload):
+        import dataclasses as _dc
+
+        return _dc.replace(payload, indices=jnp.zeros((0,), jnp.int32)), None, 0
+
+
+INDEX_CODECS: Dict[str, type] = {
+    "bloom": BloomCodec,
+    "rle": RLECodec,
+    "integer": IntegerCodec,
+    "huffman": HuffmanCodec,
+}
+
+VALUE_CODECS: Dict[str, type] = {
+    "polyfit": PolyFitCodec,
+    "doubleexp": DoubleExpCodec,
+    "qsgd": QSGDCodec,
+    "gzip": GzipCodec,
+}
+
+
+def get_codec(name: str, kind: str) -> type:
+    table = INDEX_CODECS if kind == "index" else VALUE_CODECS
+    if name not in table:
+        raise KeyError(f"unknown {kind} codec {name!r}; have {sorted(table)}")
+    return table[name]

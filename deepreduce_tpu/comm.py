@@ -1,0 +1,159 @@
+"""Gradient exchange over the device mesh — the communicator layer.
+
+Reference stack being replaced: GRACE `DistributedOptimizer` hook →
+compress → Horovod allgather (OpenMPI + NCCL) → per-worker decompress →
+``add_n / size`` aggregate (/root/reference/tensorflow/deepreduce.py:54-61;
+run_deepreduce.sh:4-9). Allgather is used *because* compressed payloads
+differ per worker (`tensors_size_are_same=False`,
+pytorch/deepreduce.py:54-59); the dense baseline uses allreduce.
+
+TPU-native equivalents:
+
+- allgather  -> `jax.lax.all_gather` of the static-budget payload pytree
+  over a mesh axis inside `shard_map`; XLA routes it over ICI.
+- allreduce  -> `jax.lax.psum` (dense baseline path).
+- aggregate  -> a `fori_loop` over the gathered leading axis, decoding each
+  worker's payload and accumulating into ONE dense buffer (the reference
+  materializes n dense tensors then `add_n`s them; the scatter-add
+  accumulator avoids the n-way peak memory).
+- residual error-feedback state rides along functionally
+  (`deepreduce_tpu.memory`).
+
+`GradientExchanger` is built once from the gradient pytree's shapes (codec
+geometry is static); its `exchange` method is called inside the
+shard_map'ped train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu import memory
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.metrics import WireStats, combine, payload_device_bytes
+from deepreduce_tpu.sparse import per_tensor_key
+from deepreduce_tpu.wrappers import TensorCodec
+
+
+def _leaf_name(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+class GradientExchanger:
+    """Compress -> all_gather -> decompress -> aggregate, per gradient tensor.
+
+    The role of the whole GRACE instance the reference builds in
+    `deepreduce_from_params` (pytorch/deepreduce.py:28-48)."""
+
+    def __init__(self, grads_like: Any, cfg: DeepReduceConfig, *, axis_name: str = "data"):
+        self.cfg = cfg
+        self.axis_name = axis_name
+        leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
+        self.names = [_leaf_name(path) for path, _ in leaves]
+        self.codecs: Dict[str, TensorCodec] = {
+            name: TensorCodec(leaf.shape, cfg, name=name)
+            for name, (path, leaf) in zip(self.names, leaves)
+        }
+
+    # ------------------------------------------------------------------ #
+
+    def init_state(self, grads_like: Any) -> Any:
+        if self.cfg.memory == "residual":
+            return memory.init(grads_like)
+        return None
+
+    def _keys(self, key: Optional[jax.Array], step: jax.Array) -> Dict[str, jax.Array]:
+        if key is None:
+            key = jax.random.PRNGKey(self.cfg.seed)
+        return {name: per_tensor_key(key, name, step) for name in self.names}
+
+    def exchange(
+        self,
+        grads: Any,
+        state: Any,
+        *,
+        step: jax.Array = 0,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[Any, Any, WireStats]:
+        """Inside shard_map over `axis_name`: returns (aggregated dense
+        grads, new residual state, combined wire stats)."""
+        cfg = self.cfg
+        num_workers = jax.lax.psum(1, self.axis_name)
+
+        if cfg.communicator == "allreduce" or cfg.deepreduce is None and cfg.compressor == "none":
+            # dense baseline: NCCL allreduce -> psum (run_deepreduce.sh:51)
+            agg = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, self.axis_name) / num_workers, grads
+            )
+            dense_bits = sum(
+                jnp.asarray(c.d, jnp.int64) * 32 for c in self.codecs.values()
+            )
+            stats = WireStats(
+                index_bits=jnp.asarray(0, jnp.int64),
+                value_bits=dense_bits,
+                dense_bits=dense_bits,
+            )
+            return agg, state, stats
+
+        # worker-distinct randomness for stochastic codecs, shared `step` for
+        # the deterministic policy contract
+        widx = jax.lax.axis_index(self.axis_name)
+        if key is None:
+            key = jax.random.PRNGKey(cfg.seed)
+        worker_key = jax.random.fold_in(key, widx)
+        keys = self._keys(worker_key, step)
+
+        compensated = grads
+        if state is not None:
+            compensated = memory.compensate(grads, state, beta=cfg.beta, gamma=cfg.gamma)
+
+        flat_grads = dict(zip(self.names, jax.tree_util.tree_leaves(compensated)))
+
+        agg_leaves = {}
+        own_leaves = {}
+        stats_per = {}
+        for name in self.names:
+            codec = self.codecs[name]
+            g = flat_grads[name]
+            payload = codec.encode(g, step=step, key=keys[name])
+            own = codec.decode(payload, step=step)
+            own_leaves[name] = own
+            stats_per[name] = codec.wire_stats(payload)
+
+            gathered = jax.lax.all_gather(payload, self.axis_name)  # leading axis W
+
+            def body(w, acc, _gathered=gathered, _codec=codec):
+                p_w = jax.tree_util.tree_map(lambda x: x[w], _gathered)
+                return acc + _codec.decode(p_w, step=step)
+
+            acc0 = jnp.zeros(codec.shape, g.dtype)
+            total = jax.lax.fori_loop(0, num_workers, body, acc0)
+            agg_leaves[name] = total / num_workers
+
+        agg = jax.tree_util.tree_unflatten(
+            self.treedef, [agg_leaves[n] for n in self.names]
+        )
+        new_state = state
+        if state is not None:
+            own = jax.tree_util.tree_unflatten(
+                self.treedef, [own_leaves[n] for n in self.names]
+            )
+            new_state = memory.update(compensated, own)
+        return agg, new_state, combine(stats_per)
+
+    # ------------------------------------------------------------------ #
+
+    def payload_bytes(self, grads_like: Any) -> int:
+        """Static allgather buffer size per worker (bytes) — what actually
+        crosses ICI each step."""
+        total = 0
+        flat = dict(zip(self.names, jax.tree_util.tree_leaves(grads_like)))
+        for name, codec in self.codecs.items():
+            payload_shape = jax.eval_shape(
+                lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)), flat[name]
+            )
+            total += payload_device_bytes(payload_shape)
+        return total

@@ -1,0 +1,75 @@
+"""Typed configuration with the reference's param-dict key names.
+
+The reference threads one flat `params` dict (serialized as a Python
+literal on the CLI, run_deepreduce.sh:35) through every wrapper and codec:
+keys ``compressor, compress_ratio, memory, communicator, deepreduce, value,
+index, fpr, policy, poly_degree, quantum_num, bucket_size, sort, threshold,
+micro-benchmark`` (README.md:30-48). `from_params` accepts exactly that
+dict; `DeepReduceConfig` is the typed equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepReduceConfig:
+    # sparsifier (GRACE 'compressor' role)
+    compressor: str = "topk"  # topk | randomk | threshold | none
+    compress_ratio: float = 0.01
+    threshold_val: float = 0.0
+    # residual error-feedback (GRACE 'memory' role)
+    memory: str = "residual"  # residual | none
+    beta: float = 1.0
+    gamma: float = 1.0
+    # collective (GRACE 'communicator' role)
+    communicator: str = "allgather"  # allgather | allreduce
+    # DeepReduce wrapper mode (README.md:31-35)
+    deepreduce: Optional[str] = None  # None | 'value' | 'index' | 'both'
+    value: str = "polyfit"  # polyfit | doubleexp | qsgd | gzip
+    index: str = "bloom"  # bloom | rle | integer | huffman (+ *_native)
+    # codec knobs
+    fpr: Optional[float] = None  # default 0.1*k/d (pytorch/deepreduce.py:511)
+    policy: str = "leftmost"  # leftmost | random | p0 | conflict_sets(native)
+    poly_degree: int = 5
+    quantum_num: int = 127
+    bucket_size: int = 512
+    sort: bool = False
+    seed: int = 0
+    # small-tensor bypass (pytorch/deepreduce.py:68)
+    min_compress_size: int = 1000
+    # observability
+    micro_benchmark: bool = False
+
+    def codec_params(self) -> Dict[str, Any]:
+        return {
+            "fpr": self.fpr,
+            "policy": self.policy,
+            "poly_degree": self.poly_degree,
+            "quantum_num": self.quantum_num,
+            "bucket_size": self.bucket_size,
+            "sort": self.sort,
+            "seed": self.seed,
+        }
+
+
+_KEY_MAP = {
+    "micro-benchmark": "micro_benchmark",
+    "threshold": "threshold_val",
+    "threshold_val": "threshold_val",
+}
+
+
+def from_params(params: Dict[str, Any]) -> DeepReduceConfig:
+    """Build a config from a reference-style params dict
+    (`deepreduce_from_params` role, pytorch/deepreduce.py:28-48). Unknown
+    keys are ignored, like the reference's dict.get discipline."""
+    fields = {f.name for f in dataclasses.fields(DeepReduceConfig)}
+    kwargs = {}
+    for key, val in params.items():
+        key = _KEY_MAP.get(key, key)
+        if key in fields:
+            kwargs[key] = val
+    return DeepReduceConfig(**kwargs)

@@ -1,0 +1,39 @@
+"""Residual error-feedback memory, as a functional optimizer-state pytree.
+
+Reference parity: GRACE's ``'memory': 'residual'`` on the PyTorch path
+(run_deepreduce.sh:35,107) and the TF ``Compressor.memory_compensate`` /
+``memory_update`` pair (/root/reference/tensorflow/deepreduce.py:31-52):
+
+    compensated = beta * residual + gamma * grad
+    residual'   = compensated - decompressed
+
+(The TF reference re-creates a zero residual variable at graph build —
+tensorflow/deepreduce.py:39-40 — making its residual a no-op; we implement
+the *spec*, the accumulating residual, per SURVEY.md §2.7.)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def init(params_or_grads: Any) -> Any:
+    """Zero residual with the same pytree structure as the gradients."""
+    return jax.tree_util.tree_map(jnp.zeros_like, params_or_grads)
+
+
+def compensate(grads: Any, residuals: Any, *, beta: float = 1.0, gamma: float = 1.0) -> Any:
+    """compensated = beta * residual + gamma * grad (tensorflow/deepreduce.py:41)."""
+    return jax.tree_util.tree_map(lambda r, g: beta * r + gamma * g, residuals, grads)
+
+
+def update(compensated: Any, decompressed: Any) -> Any:
+    """residual' = compensated - decompressed (tensorflow/deepreduce.py:43-52).
+
+    `decompressed` is *this worker's own* decompressed contribution, so the
+    residual holds exactly the gradient mass the codec dropped this step.
+    """
+    return jax.tree_util.tree_map(lambda c, d: c - d, compensated, decompressed)

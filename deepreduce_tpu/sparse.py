@@ -1,0 +1,171 @@
+"""Sparse-gradient core: the `SparseGrad` pytree and the sparsifiers.
+
+Reference parity: GRACE supplies `topk`/`randomk`/`threshold` sparsifiers on
+the PyTorch path, and the TF path fuses them into the codec
+(/root/reference/tensorflow/deepreduce.py:273-298). Here they are pure JAX
+functions with *static* output shapes: every sparsifier returns exactly
+`k` slots; `nnz` says how many are live, and dead slots carry
+``index = 0, value = 0`` so that scatter-adds of padding are no-ops.
+
+The reference's `randomk` seeds by ``hash(tensor_name) + global_step``
+(tensorflow/deepreduce.py:293) and its GPU bloom `random` policy re-seeds
+``torch.manual_seed(42)`` every call — an acknowledged bug
+(pytorch/deepreduce.py:484-488). We take explicit `jax.random` keys instead;
+helpers derive per-tensor per-step keys so no two steps repeat.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _static_field(**kw):
+    return dataclasses.field(metadata=dict(static=True), **kw)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SparseGrad:
+    """A sparsified gradient with a static slot budget.
+
+    values:  f32[k]  — kept magnitudes (0 in dead slots)
+    indices: i32[k]  — flat positions into the dense tensor (0 in dead slots)
+    nnz:     i32[]   — number of live slots (<= k)
+    shape:   static  — dense tensor shape (the reference threads `ctx=shape`,
+                       pytorch/deepreduce.py:64)
+    """
+
+    values: jax.Array
+    indices: jax.Array
+    nnz: jax.Array
+    shape: Tuple[int, ...] = _static_field(default=())
+
+    @property
+    def k(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def dense_size(self) -> int:
+        size = 1
+        for s in self.shape:
+            size *= int(s)
+        return size
+
+    def to_dense(self) -> jax.Array:
+        """Scatter values back to a dense tensor (GRACE sparsifier.decompress
+        role, pytorch/deepreduce.py:301)."""
+        d = self.dense_size
+        mask = live_mask(self)
+        vals = jnp.where(mask, self.values, 0.0)
+        idxs = jnp.where(mask, self.indices, 0)
+        dense = jnp.zeros((d,), self.values.dtype).at[idxs].add(vals)
+        return dense.reshape(self.shape)
+
+
+def live_mask(sp: SparseGrad) -> jax.Array:
+    """Boolean [k] mask of live slots."""
+    return jnp.arange(sp.k, dtype=jnp.int32) < sp.nnz
+
+
+def num_slots(dense_size: int, compress_ratio: float) -> int:
+    """k = max(1, N * ratio) (tensorflow/deepreduce.py:307-308)."""
+    return max(1, int(dense_size * compress_ratio))
+
+
+def topk(tensor: jax.Array, compress_ratio: float, *, sort_indices: bool = True) -> SparseGrad:
+    """Top-k by magnitude. Indices ascending when `sort_indices` (the TF
+    reference sorts, tensorflow/deepreduce.py:276)."""
+    flat = tensor.reshape(-1)
+    k = num_slots(flat.shape[0], compress_ratio)
+    _, idxs = jax.lax.top_k(jnp.abs(flat), k)
+    if sort_indices:
+        idxs = jnp.sort(idxs)
+    vals = flat[idxs]
+    return SparseGrad(
+        values=vals,
+        indices=idxs.astype(jnp.int32),
+        nnz=jnp.asarray(k, jnp.int32),
+        shape=tuple(tensor.shape),
+    )
+
+
+def randomk(
+    tensor: jax.Array, compress_ratio: float, key: jax.Array, *, sort_indices: bool = True
+) -> SparseGrad:
+    """Uniform random k of d without replacement, keyed per tensor per step
+    (fixing the reference's fixed-seed quirk, pytorch/deepreduce.py:484-488).
+
+    Implemented as top-k over i.i.d. uniform priorities — O(d log k), static
+    shapes, no d-length permutation materialised.
+    """
+    flat = tensor.reshape(-1)
+    d = flat.shape[0]
+    k = num_slots(d, compress_ratio)
+    priorities = jax.random.uniform(key, (d,))
+    _, idxs = jax.lax.top_k(priorities, k)
+    if sort_indices:
+        idxs = jnp.sort(idxs)
+    vals = flat[idxs]
+    return SparseGrad(
+        values=vals,
+        indices=idxs.astype(jnp.int32),
+        nnz=jnp.asarray(k, jnp.int32),
+        shape=tuple(tensor.shape),
+    )
+
+
+def threshold(tensor: jax.Array, threshold_val: float, *, budget_ratio: float = 1.0) -> SparseGrad:
+    """Keep |g| >= max(threshold, needed-to-fit-budget).
+
+    The reference clamps the threshold down to the max |g| so at least one
+    element survives (tensorflow/deepreduce.py:283) and emits a dynamic-size
+    index list. Static-shape version: the slot budget is
+    ``d * budget_ratio``; if more elements pass the threshold than fit, the
+    largest-magnitude ones win. ``threshold_val=0.0`` captures natural
+    sparsity (the NCF config, run_deepreduce.sh:89) — with 0.0 strictly
+    *greater-equal* every element passes, so pair it with a budget_ratio
+    sized to the model's true sparsity.
+    """
+    flat = tensor.reshape(-1)
+    d = flat.shape[0]
+    k = num_slots(d, budget_ratio)
+    mags = jnp.abs(flat)
+    thr = jnp.minimum(jnp.asarray(threshold_val, flat.dtype), jnp.max(mags))
+    vals_top, idxs = jax.lax.top_k(mags, k)
+    keep = vals_top >= thr
+    nnz = jnp.sum(keep).astype(jnp.int32)
+    # Compact live slots to the front, preserving ascending index order.
+    idxs = jnp.where(keep, idxs, d)  # push dead slots to the end of the sort
+    idxs = jnp.sort(idxs)
+    mask = jnp.arange(k, dtype=jnp.int32) < nnz
+    idxs = jnp.where(mask, idxs, 0)
+    vals = jnp.where(mask, flat[idxs], 0.0)
+    return SparseGrad(
+        values=vals,
+        indices=idxs.astype(jnp.int32),
+        nnz=nnz,
+        shape=tuple(tensor.shape),
+    )
+
+
+def none_sparsifier(tensor: jax.Array) -> SparseGrad:
+    """Identity sparsifier (the dense baseline's 'none', run_deepreduce.sh:51)."""
+    flat = tensor.reshape(-1)
+    d = flat.shape[0]
+    return SparseGrad(
+        values=flat,
+        indices=jnp.arange(d, dtype=jnp.int32),
+        nnz=jnp.asarray(d, jnp.int32),
+        shape=tuple(tensor.shape),
+    )
+
+
+def per_tensor_key(base_key: jax.Array, name: str, step: jax.Array) -> jax.Array:
+    """Per-tensor per-step PRNG key — the role of the reference's
+    ``hash(tensor_name) + global_step`` seed (tensorflow/deepreduce.py:293)."""
+    name_hash = jnp.uint32(abs(hash(name)) % (2**31))
+    return jax.random.fold_in(jax.random.fold_in(base_key, name_hash), step)

@@ -1,0 +1,204 @@
+"""Composition layer: the DeepReduce wrapper modes over a sparsifier.
+
+Reference parity (/root/reference/pytorch/deepreduce.py:51-302):
+
+- ``deepreduce=None``  — sparsify only (plain Top-r): raw (values, indices).
+- ``'value'``          — sparsify, then value-compress (`ValueCompressor`).
+- ``'index'``          — sparsify, then index-compress with FP-aware value
+                         re-read from the dense tensor (`IndexCompressor`,
+                         :117 passes the dense tensor in).
+- ``'both'``           — index codec first; the value codec then runs on the
+                         *codec-ordered* values with fresh arange indices,
+                         producing a sort `mapping` transmitted alongside
+                         (:262-263); decompress applies ``idxs[mapping]`` to
+                         undo both reorderings (:290).
+
+Differences by design: the `mapping` is always bit-packed at the static
+width ceil(log2 k) (the reference left its `pack` call commented out
+:264-265; the paper's volume numbers assume packing, pdf p.46) — and when
+the value codec is order-preserving (QSGD — the DRQSGD-BF-P0 headline
+config) the mapping is elided entirely, since it is the identity.
+
+Small-tensor bypass: tensors with <= `min_compress_size` elements skip
+compression (pytorch/deepreduce.py:68) — a *static* decision per tensor, so
+jit sees a fixed payload structure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu import sparse
+from deepreduce_tpu.codecs import packing
+from deepreduce_tpu.codecs.registry import get_codec
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.metrics import WireStats
+from deepreduce_tpu.sparse import SparseGrad
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BothPayload:
+    """'both' wire format: index payload (values stripped), value payload,
+    packed mapping (pytorch/deepreduce.py:267)."""
+
+    index_payload: Any
+    value_payload: Any
+    mapping: Optional[packing.PackedInts]
+    nsel: jax.Array
+
+
+class TensorCodec:
+    """Per-tensor compressor bound to a static shape — the role of the
+    reference's wrapper instance installed as `grc.compressor`
+    (pytorch/deepreduce.py:45-46)."""
+
+    def __init__(self, shape: Tuple[int, ...], cfg: DeepReduceConfig, name: str = ""):
+        self.shape = tuple(int(s) for s in shape)
+        self.cfg = cfg
+        self.name = name
+        self.d = int(math.prod(self.shape)) if self.shape else 1
+        self.compressed = (
+            cfg.deepreduce is not None and self.d > cfg.min_compress_size
+        )
+        if cfg.compressor == "none":
+            self.k = self.d
+        elif cfg.compressor == "threshold":
+            self.k = sparse.num_slots(self.d, cfg.compress_ratio)
+        else:
+            self.k = sparse.num_slots(self.d, cfg.compress_ratio)
+
+        params = cfg.codec_params()
+        self.idx_codec = None
+        self.val_codec = None
+        if self.compressed and cfg.deepreduce in ("index", "both"):
+            self.idx_codec = get_codec(cfg.index, "index")(self.k, self.d, params)
+        if self.compressed and cfg.deepreduce in ("value", "both"):
+            if cfg.deepreduce == "both":
+                # the value codec sees the index codec's selected values —
+                # its slot count is the index codec's budget
+                vk = self.idx_codec.meta.budget if hasattr(self.idx_codec, "meta") and hasattr(
+                    self.idx_codec.meta, "budget"
+                ) else self.k
+                self.val_codec = get_codec(cfg.value, "value")(vk, self.d, params)
+            else:
+                self.val_codec = get_codec(cfg.value, "value")(self.k, self.d, params)
+        # mapping pack width: ceil(log2 k) bits (paper pdf p.46)
+        self._map_width = max(1, math.ceil(math.log2(max(2, self.k))))
+
+    # ------------------------------------------------------------------ #
+
+    def sparsify(self, tensor: jax.Array, *, key: Optional[jax.Array] = None) -> SparseGrad:
+        cfg = self.cfg
+        if cfg.compressor == "topk":
+            return sparse.topk(tensor, cfg.compress_ratio)
+        if cfg.compressor == "randomk":
+            if key is None:
+                raise ValueError("randomk sparsifier needs a PRNG key")
+            return sparse.randomk(tensor, cfg.compress_ratio, key)
+        if cfg.compressor == "threshold":
+            return sparse.threshold(tensor, cfg.threshold_val, budget_ratio=cfg.compress_ratio)
+        if cfg.compressor == "none":
+            return sparse.none_sparsifier(tensor)
+        raise ValueError(f"unknown sparsifier {cfg.compressor!r}")
+
+    def encode(
+        self, tensor: jax.Array, *, step: jax.Array = 0, key: Optional[jax.Array] = None
+    ) -> Any:
+        """tensor -> payload (the reference's wrapper.compress,
+        pytorch/deepreduce.py:250-272)."""
+        sp = self.sparsify(tensor, key=key)
+        if not self.compressed:
+            return sp
+
+        mode = self.cfg.deepreduce
+        if mode == "value":
+            return self.val_codec.encode(sp, step=step, key=key)
+        if mode == "index":
+            return self.idx_codec.encode(sp, dense=tensor, step=step, key=key)
+
+        # both: index codec first (FP-aware), then value codec over the
+        # selected values with fresh arange indices (pytorch/deepreduce.py:261-263)
+        ipay = self.idx_codec.encode(sp, dense=tensor, step=step, key=key)
+        sel_vals = ipay.values
+        vk = sel_vals.shape[0]
+        nsel = getattr(ipay, "nsel", None)
+        nsel = sp.nnz if nsel is None else nsel
+        inner = SparseGrad(
+            values=sel_vals,
+            indices=jnp.arange(vk, dtype=jnp.int32),
+            nnz=nsel,
+            shape=(vk,),
+        )
+        vpay = self.val_codec.encode(inner, step=step, key=key)
+        vpay, mapping_arr, mapping_max = self.val_codec.strip_for_both(vpay)
+        if mapping_arr is None:
+            mapping = None
+        else:
+            width = max(1, math.ceil(math.log2(max(2, mapping_max + 1))))
+            mapping = packing.pack(mapping_arr, jnp.asarray(width, jnp.int32), max_width=width)
+        ipay_stripped = dataclasses.replace(ipay, values=jnp.zeros((0,), jnp.float32))
+        return BothPayload(
+            index_payload=ipay_stripped, value_payload=vpay, mapping=mapping, nsel=nsel
+        )
+
+    def decode(self, payload: Any, *, step: jax.Array = 0) -> jax.Array:
+        """payload -> dense tensor (wrapper.decompress,
+        pytorch/deepreduce.py:274-302)."""
+        if not self.compressed:
+            return payload.to_dense()
+
+        mode = self.cfg.deepreduce
+        if mode == "value":
+            return self.val_codec.decode(payload, self.shape, step=step).to_dense()
+        if mode == "index":
+            return self.idx_codec.decode(payload, self.shape, step=step).to_dense()
+
+        vk = self.val_codec.k
+        if payload.mapping is None:
+            mapping_arr = None
+        else:
+            mapping_max = self.val_codec.both_mapping_max()
+            w = max(1, math.ceil(math.log2(max(2, mapping_max + 1))))
+            mapping_arr = packing.unpack(payload.mapping, vk, max_width=w)
+        vpay = self.val_codec.restore_for_both(payload.value_payload, mapping_arr)
+        vsp = self.val_codec.decode(vpay, self.shape, step=step)  # codec-order values
+        ipay = dataclasses.replace(
+            payload.index_payload, values=jnp.zeros((vk,), jnp.float32)
+        )
+        isp = self.idx_codec.decode(ipay, self.shape, step=step)  # ascending indices
+        # undo both reorderings (:290): vsp.indices maps codec order -> selection slot
+        sel = jnp.clip(vsp.indices, 0, vk - 1)
+        idxs = isp.indices[sel]
+        out = SparseGrad(values=vsp.values, indices=idxs, nnz=payload.nsel, shape=self.shape)
+        return out.to_dense()
+
+    # ------------------------------------------------------------------ #
+
+    def wire_stats(self, payload: Any) -> WireStats:
+        dense_bits = jnp.asarray(self.d, jnp.int64) * 32
+        if not self.compressed:
+            nnz = payload.nnz.astype(jnp.int64)
+            idx_bits = nnz * 32
+            val_bits = nnz * 32
+        elif self.cfg.deepreduce == "value":
+            idx_bits = self.val_codec.index_wire_bits(payload)
+            val_bits = self.val_codec.value_wire_bits(payload)
+        elif self.cfg.deepreduce == "index":
+            idx_bits = self.idx_codec.index_wire_bits(payload)
+            val_bits = self.idx_codec.value_wire_bits(payload)
+        else:
+            idx_bits = self.idx_codec.index_wire_bits(payload.index_payload)
+            if payload.mapping is not None:
+                idx_bits = idx_bits + packing.wire_bits(payload.mapping).astype(jnp.int64)
+            val_bits = self.val_codec.value_wire_bits(payload.value_payload)
+        return WireStats(
+            index_bits=jnp.asarray(idx_bits, jnp.int64),
+            value_bits=jnp.asarray(val_bits, jnp.int64),
+            dense_bits=dense_bits,
+        )

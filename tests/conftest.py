@@ -1,0 +1,10 @@
+"""Test env: 8 virtual CPU devices so multi-worker collectives run without a
+pod — the multi-host simulation the reference's MPI-only world couldn't do
+(SURVEY.md §4). Must run before jax is imported anywhere."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

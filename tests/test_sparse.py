@@ -1,0 +1,87 @@
+"""Sparsifier and SparseGrad tests (vs numpy oracles)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepreduce_tpu import sparse
+
+
+def test_topk_matches_numpy():
+    rng = np.random.default_rng(0)
+    g = rng.normal(size=(40, 50)).astype(np.float32)
+    sp = sparse.topk(jnp.asarray(g), 0.01)
+    k = max(1, int(g.size * 0.01))
+    assert sp.k == k
+    want = set(np.argsort(-np.abs(g.reshape(-1)))[:k].tolist())
+    assert set(np.asarray(sp.indices).tolist()) == want
+    np.testing.assert_allclose(np.asarray(sp.values), g.reshape(-1)[np.asarray(sp.indices)])
+    assert int(sp.nnz) == k
+
+
+def test_topk_indices_sorted():
+    g = np.random.default_rng(1).normal(size=(5000,)).astype(np.float32)
+    sp = sparse.topk(jnp.asarray(g), 0.02)
+    idx = np.asarray(sp.indices)
+    assert np.all(np.diff(idx) > 0)
+
+
+def test_to_dense_round_trip():
+    g = np.random.default_rng(2).normal(size=(64, 32)).astype(np.float32)
+    sp = sparse.topk(jnp.asarray(g), 0.05)
+    dense = np.asarray(sp.to_dense())
+    assert dense.shape == g.shape
+    flat = g.reshape(-1)
+    idx = np.asarray(sp.indices)
+    np.testing.assert_allclose(dense.reshape(-1)[idx], flat[idx])
+    mask = np.zeros(g.size, bool)
+    mask[idx] = True
+    assert np.all(dense.reshape(-1)[~mask] == 0)
+
+
+def test_randomk_distinct_and_keyed():
+    g = jnp.ones((10000,))
+    k1 = jax.random.PRNGKey(0)
+    k2 = jax.random.PRNGKey(1)
+    sp1 = sparse.randomk(g, 0.01, k1)
+    sp2 = sparse.randomk(g, 0.01, k2)
+    idx1 = np.asarray(sp1.indices)
+    assert len(set(idx1.tolist())) == sp1.k  # without replacement
+    assert not np.array_equal(idx1, np.asarray(sp2.indices))  # key matters
+    sp1b = sparse.randomk(g, 0.01, k1)
+    np.testing.assert_array_equal(idx1, np.asarray(sp1b.indices))  # deterministic
+
+
+def test_threshold_semantics():
+    g = np.zeros(5000, np.float32)
+    hot = np.random.default_rng(3).choice(5000, 37, replace=False)
+    g[hot] = np.random.default_rng(4).normal(size=37).astype(np.float32) + 5.0
+    sp = sparse.threshold(jnp.asarray(g), 1.0, budget_ratio=0.02)
+    assert int(sp.nnz) == 37
+    live_idx = np.asarray(sp.indices)[: int(sp.nnz)]
+    assert set(live_idx.tolist()) == set(hot.tolist())
+    # dense reconstruction exact
+    np.testing.assert_allclose(np.asarray(sp.to_dense()), g)
+
+
+def test_threshold_budget_overflow_keeps_largest():
+    g = np.arange(1, 1001, dtype=np.float32)
+    sp = sparse.threshold(jnp.asarray(g), 0.5, budget_ratio=0.01)  # budget 10, all pass thr
+    assert int(sp.nnz) == 10
+    live = np.asarray(sp.indices)[:10]
+    assert set(live.tolist()) == set(range(990, 1000))
+
+
+def test_none_sparsifier():
+    g = np.random.default_rng(5).normal(size=(33,)).astype(np.float32)
+    sp = sparse.none_sparsifier(jnp.asarray(g))
+    np.testing.assert_allclose(np.asarray(sp.to_dense()), g)
+
+
+def test_sparsifiers_jit_stable():
+    g = jnp.asarray(np.random.default_rng(6).normal(size=(2048,)).astype(np.float32))
+    f = jax.jit(lambda x: sparse.topk(x, 0.01))
+    sp = f(g)
+    sp2 = f(g * 2)
+    assert sp.values.shape == sp2.values.shape

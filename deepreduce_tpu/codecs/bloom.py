@@ -260,7 +260,7 @@ def decode(
 def wire_bits(payload: BloomPayload, meta: BloomMeta) -> jax.Array:
     """Filter bits + selected values + count word (the C++ wire format
     ``[m | h | values | bit-array]``, bloom_filter_compression.cc:112-141)."""
-    return jnp.asarray(64 + meta.m_bits, jnp.int64) + payload.nsel.astype(jnp.int64) * 32
+    return jnp.asarray(64.0 + meta.m_bits, jnp.float32) + payload.nsel.astype(jnp.float32) * 32
 
 
 def measured_fpr(sp: SparseGrad, words: jax.Array, meta: BloomMeta) -> jax.Array:

@@ -125,4 +125,4 @@ def decode(payload: DoubleExpPayload, meta: DoubleExpMeta, shape: Tuple[int, ...
 
 
 def wire_bits(payload: DoubleExpPayload, meta: DoubleExpMeta) -> jax.Array:
-    return jnp.asarray(4 * 32, jnp.int64)  # values side: 4 f32 coefficients
+    return jnp.asarray(4.0 * 32, jnp.float32)  # values side: 4 f32 coefficients

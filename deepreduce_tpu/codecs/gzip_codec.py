@@ -44,13 +44,13 @@ def encode(sp: SparseGrad, meta: GzipMeta) -> GzipPayload:
         packed = zlib.compress(np.ascontiguousarray(vals.astype("<f4")).tobytes())
         out = np.zeros(meta.budget_bytes, np.uint8)
         out[: len(packed)] = np.frombuffer(packed, np.uint8)
-        return out, np.int64(len(packed))
+        return out, np.int32(len(packed))
 
     stream, nbytes = jax.pure_callback(
         host,
         (
             jax.ShapeDtypeStruct((meta.budget_bytes,), jnp.uint8),
-            jax.ShapeDtypeStruct((), jnp.int64),
+            jax.ShapeDtypeStruct((), jnp.int32),
         ),
         sp.values,
     )
@@ -69,4 +69,4 @@ def decode(payload: GzipPayload, meta: GzipMeta, shape: Tuple[int, ...]) -> Spar
 
 
 def wire_bits(payload: GzipPayload, meta: GzipMeta) -> jax.Array:
-    return payload.nbytes.astype(jnp.int64) * 8 + 64
+    return payload.nbytes.astype(jnp.float32) * 8 + 64

@@ -108,7 +108,7 @@ def _encode_host(idx_bytes: np.ndarray, d: int, budget: int) -> Tuple[np.ndarray
     stream = np.packbits(flat_bits)
     out = np.zeros(budget, np.uint8)
     out[: stream.size] = stream
-    return out, np.int64(total)
+    return out, np.int32(total)
 
 
 def _decode_host(stream: np.ndarray, nbits: int, n_syms: int, d: int) -> np.ndarray:
@@ -164,7 +164,7 @@ def encode(sp: SparseGrad, meta: HuffmanMeta) -> HuffmanPayload:
         host,
         (
             jax.ShapeDtypeStruct((meta.budget_bytes,), jnp.uint8),
-            jax.ShapeDtypeStruct((), jnp.int64),
+            jax.ShapeDtypeStruct((), jnp.int32),
         ),
         sp.indices,
     )
@@ -183,4 +183,4 @@ def decode(payload: HuffmanPayload, meta: HuffmanMeta, shape: Tuple[int, ...]) -
 
 
 def wire_bits(payload: HuffmanPayload, meta: HuffmanMeta) -> jax.Array:
-    return payload.nbits.astype(jnp.int64) + 64
+    return payload.nbits.astype(jnp.float32) + 64

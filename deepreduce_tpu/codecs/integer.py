@@ -69,4 +69,4 @@ def decode(payload: IntegerPayload, meta: IntegerMeta, shape: Tuple[int, ...]) -
 
 
 def wire_bits(payload: IntegerPayload, meta: IntegerMeta) -> jax.Array:
-    return packing.wire_bits(payload.deltas).astype(jnp.int64)
+    return packing.wire_bits(payload.deltas).astype(jnp.float32)

@@ -147,5 +147,5 @@ def wire_bits(payload: PolyFitPayload, meta: PolyFitMeta) -> jax.Array:
     """Only active segments' coefficients count (+32 for num_pos, the
     reference's appended coefficient :405); the [S, p] buffer is padding."""
     sizes = segment_sizes(meta.k, payload.num_pos)
-    active = jnp.sum((sizes > 0).astype(jnp.int64))
+    active = jnp.sum((sizes > 0).astype(jnp.float32))
     return active * (meta.degree + 1) * 32 + 32

@@ -74,6 +74,6 @@ def decode(payload: QSGDPayload, meta: QSGDMeta, shape: Tuple[int, ...]) -> Spar
 
 def wire_bits(payload: QSGDPayload, meta: QSGDMeta) -> jax.Array:
     """8 bits per level + 32 bits of norm per bucket (reference layout)."""
-    nnz = payload.nnz.astype(jnp.int64)
+    nnz = payload.nnz.astype(jnp.float32)
     full_buckets = (nnz + meta.bucket_size - 1) // meta.bucket_size
     return nnz * 8 + full_buckets * 32

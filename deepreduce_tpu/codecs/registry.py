@@ -92,7 +92,7 @@ class Codec:
 
 
 def _raw_value_bits(n) -> jax.Array:
-    return jnp.asarray(n, jnp.int64) * 32
+    return jnp.asarray(n, jnp.float32) * 32
 
 
 class BloomCodec(Codec):
@@ -114,10 +114,10 @@ class BloomCodec(Codec):
         return bloom.decode(payload, self.meta, shape, step=step, seed=self.seed)
 
     def index_wire_bits(self, payload):
-        return jnp.asarray(64 + self.meta.m_bits, jnp.int64)
+        return jnp.asarray(64.0 + self.meta.m_bits, jnp.float32)
 
     def value_wire_bits(self, payload):
-        return payload.nsel.astype(jnp.int64) * 32
+        return payload.nsel.astype(jnp.float32) * 32
 
 
 class RLECodec(Codec):

@@ -117,4 +117,4 @@ def decode(payload: RLEPayload, meta: RLEMeta, shape: Tuple[int, ...]) -> Sparse
 
 
 def wire_bits(payload: RLEPayload, meta: RLEMeta) -> jax.Array:
-    return packing.wire_bits(payload.runs).astype(jnp.int64)
+    return packing.wire_bits(payload.runs).astype(jnp.float32)

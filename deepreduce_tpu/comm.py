@@ -89,10 +89,10 @@ class GradientExchanger:
                 lambda g: jax.lax.psum(g, self.axis_name) / num_workers, grads
             )
             dense_bits = sum(
-                jnp.asarray(c.d, jnp.int64) * 32 for c in self.codecs.values()
+                jnp.asarray(c.d * 32, jnp.float32) for c in self.codecs.values()
             )
             stats = WireStats(
-                index_bits=jnp.asarray(0, jnp.int64),
+                index_bits=jnp.asarray(0.0, jnp.float32),
                 value_bits=dense_bits,
                 dense_bits=dense_bits,
             )

@@ -1,0 +1,29 @@
+"""Model zoo covering the reference's benchmark families (BASELINE.md):
+
+- ResNet-20 / CIFAR-10 and ResNet-50 / ImageNet (paper Table 1)
+- DenseNet40-K12 / CIFAR-10 (paper Table 1)
+- MobileNet / CIFAR-10 (paper Table 5, FL testbed)
+- NCF / MovieLens-20M (paper Table 1/6 — the natively-sparse config)
+- LSTM / StackOverflow next-word (paper Table 1/2, FedAvg testbed)
+- BERT-base encoder (BASELINE.json config 5 — the new ICI stress test)
+
+All flax.linen, bfloat16-friendly, written for the MXU (convs/matmuls
+batched and channel-last; no dynamic shapes).
+"""
+
+from deepreduce_tpu.models.bert import BertEncoder
+from deepreduce_tpu.models.densenet import DenseNet40
+from deepreduce_tpu.models.lstm import WordLSTM
+from deepreduce_tpu.models.mobilenet import MobileNetV1
+from deepreduce_tpu.models.ncf import NeuMF
+from deepreduce_tpu.models.resnet import ResNet20, ResNet50
+
+__all__ = [
+    "ResNet20",
+    "ResNet50",
+    "DenseNet40",
+    "MobileNetV1",
+    "NeuMF",
+    "WordLSTM",
+    "BertEncoder",
+]

@@ -1,0 +1,57 @@
+"""BERT-base encoder — BASELINE.json config 5: a *new* stress test of the
+allgather path at 110M params (the reference has no attention models;
+SURVEY.md §5 'long-context: absent'). Written MXU-first: fused QKV matmul,
+bf16-friendly, static seq length."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class TransformerLayer(nn.Module):
+    hidden: int
+    heads: int
+    mlp_dim: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, qkv_features=self.hidden, dtype=self.dtype
+        )(h, h, mask=mask)
+        x = x + attn
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.hidden, dtype=self.dtype)(h)
+        return x + h
+
+
+class BertEncoder(nn.Module):
+    vocab_size: int = 30_522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):  # [batch, seq] int32 -> MLM logits
+        seq = tokens.shape[1]
+        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype, name="tok")(tokens)
+        pos = nn.Embed(self.max_len, self.hidden, dtype=self.dtype, name="pos")(
+            jnp.arange(seq, dtype=jnp.int32)
+        )
+        x = x + pos[None, :, :]
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        for _ in range(self.layers):
+            x = TransformerLayer(self.hidden, self.heads, self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="mlm")(x)

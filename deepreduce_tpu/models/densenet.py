@@ -1,0 +1,57 @@
+"""DenseNet-40 (growth 12) for CIFAR-10 — paper Table 1's second CIFAR
+model (357,491 params, baseline 91.76%)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class DenseLayer(nn.Module):
+    growth: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        y = nn.relu(y)
+        y = nn.Conv(self.growth, (3, 3), use_bias=False, dtype=self.dtype)(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class Transition(nn.Module):
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        y = nn.relu(y)
+        y = nn.Conv(x.shape[-1], (1, 1), use_bias=False, dtype=self.dtype)(y)
+        return nn.avg_pool(y, (2, 2), (2, 2))
+
+
+class DenseNet40(nn.Module):
+    """3 dense blocks x 12 layers, growth 12."""
+
+    num_classes: int = 10
+    growth: int = 12
+    layers_per_block: int = 12
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(16, (3, 3), use_bias=False, dtype=self.dtype)(x)
+        for block in range(3):
+            for _ in range(self.layers_per_block):
+                x = DenseLayer(self.growth, dtype=self.dtype)(x, train)
+            if block < 2:
+                x = Transition(dtype=self.dtype)(x, train)
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,99 @@
+"""ResNets: ResNet-20 (CIFAR, v2 pre-activation — the reference benchmarks
+resnet20_v2, tensorflow/deepreduce.py:184) and ResNet-50 (ImageNet,
+bottleneck v1.5). 269,722 params for ResNet-20 / 25.6M for ResNet-50 per
+BASELINE.md Table 1 — the gradient pytrees the codecs are sized against."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class BasicBlockV2(nn.Module):
+    filters: int
+    stride: int = 1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        y = norm()(x)
+        y = nn.relu(y)
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.filters:
+            shortcut = conv(self.filters, (1, 1), (self.stride, self.stride))(y)
+        y = conv(self.filters, (3, 3), (self.stride, self.stride))(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3))(y)
+        return y + shortcut
+
+
+class ResNet20(nn.Module):
+    """Pre-activation ResNet-20 for 32x32 inputs, 10 classes."""
+
+    num_classes: int = 10
+    width: int = 16
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.width, (3, 3), use_bias=False, dtype=self.dtype)(x)
+        for i, filters in enumerate((self.width, 2 * self.width, 4 * self.width)):
+            for j in range(3):
+                stride = 2 if i > 0 and j == 0 else 1
+                x = BasicBlockV2(filters, stride, dtype=self.dtype)(x, train)
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != 4 * self.filters:
+            shortcut = conv(4 * self.filters, (1, 1), (self.stride, self.stride))(x)
+            shortcut = norm()(shortcut)
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), (self.stride, self.stride))(y)
+        y = nn.relu(norm()(y))
+        y = conv(4 * self.filters, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        return nn.relu(y + shortcut)
+
+
+class ResNet50(nn.Module):
+    """Bottleneck ResNet-50 for 224x224 ImageNet (25.6M params)."""
+
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            filters = 64 * 2**i
+            for j in range(block_count):
+                stride = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(filters, stride, dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

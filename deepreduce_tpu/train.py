@@ -1,0 +1,190 @@
+"""Training-step builder: the GRACE `DistributedOptimizer` hook, TPU-style.
+
+Reference flow (SURVEY.md §3.1): backward -> per-gradient compensate ->
+compress -> allgather -> decompress -> aggregate -> memory.update ->
+optimizer.step, orchestrated by GRACE inside Horovod's optimizer wrapper.
+Here the whole step is ONE spmd function under `shard_map` over the data
+axis of a `jax.sharding.Mesh`:
+
+- params / optimizer state are replicated (every worker applies the same
+  aggregated update, like the reference's synchronous DP);
+- the residual error-feedback state is *worker-local* — it lives sharded
+  over the mesh's data axis with a leading [num_workers] dim outside the
+  shard_map (the reference keeps it in per-process GRACE memory);
+- batch is sharded over the data axis;
+- the gradient exchange is `deepreduce_tpu.comm.GradientExchanger`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepreduce_tpu.comm import GradientExchanger
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.metrics import WireStats
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TrainState:
+    params: Any
+    batch_stats: Any  # flax BatchNorm running stats ({} if unused)
+    opt_state: Any
+    residuals: Any  # worker-local error-feedback (None if memory='none')
+    step: jax.Array
+
+
+def classification_loss(model) -> Callable:
+    """(params, batch_stats, batch) -> (loss, new_batch_stats) for flax
+    models with optional BatchNorm; batch = (images, int labels)."""
+
+    def loss_fn(params, batch_stats, batch):
+        images, labels = batch
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+            logits, mutated = model.apply(
+                variables, images, train=True, mutable=["batch_stats"]
+            )
+            new_stats = mutated["batch_stats"]
+        else:
+            logits = model.apply(variables, images)
+            new_stats = batch_stats
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+        return loss, new_stats
+
+    return loss_fn
+
+
+def make_worker_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    exchanger: GradientExchanger,
+) -> Callable:
+    """The per-worker spmd step (call inside shard_map over the exchanger's
+    axis)."""
+    axis = exchanger.axis_name
+
+    def step_fn(state: TrainState, batch, key: jax.Array):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.batch_stats, batch
+        )
+        loss = jax.lax.pmean(loss, axis)
+        if new_stats:
+            new_stats = jax.lax.pmean(new_stats, axis)
+
+        agg, new_residuals, wire = exchanger.exchange(
+            grads, state.residuals, step=state.step, key=key
+        )
+        updates, new_opt = optimizer.update(agg, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        wire_mean = WireStats(
+            index_bits=jax.lax.pmean(wire.index_bits.astype(jnp.float32), axis),
+            value_bits=jax.lax.pmean(wire.value_bits.astype(jnp.float32), axis),
+            dense_bits=wire.dense_bits.astype(jnp.float32),
+        )
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+            residuals=new_residuals,
+            step=state.step + 1,
+        )
+        return new_state, loss, wire_mean
+
+    return step_fn
+
+
+class Trainer:
+    """End-to-end distributed trainer over a mesh data axis — the role of the
+    reference's benchmark driver + GRACE wiring (run_deepreduce.sh)."""
+
+    def __init__(
+        self,
+        model,
+        cfg: DeepReduceConfig,
+        optimizer: optax.GradientTransformation,
+        mesh: Mesh,
+        *,
+        axis_name: str = "data",
+        loss_fn: Optional[Callable] = None,
+    ):
+        self.model = model
+        self.cfg = cfg
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.loss_fn = loss_fn or classification_loss(model)
+        self.exchanger: Optional[GradientExchanger] = None
+        self._step_fn = None
+
+    @property
+    def num_workers(self) -> int:
+        return self.mesh.shape[self.axis_name]
+
+    def init_state(self, rng: jax.Array, sample_batch) -> TrainState:
+        sample_input = sample_batch[0]
+        if isinstance(sample_input, (tuple, list)):
+            variables = self.model.init(rng, *sample_input)
+        else:
+            variables = self.model.init(rng, sample_input)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        self.exchanger = GradientExchanger(params, self.cfg, axis_name=self.axis_name)
+        residuals = self.exchanger.init_state(params)
+        if residuals is not None:
+            # worker-local residual: leading [num_workers] axis, sharded
+            residuals = jax.tree_util.tree_map(
+                lambda r: jnp.broadcast_to(r[None], (self.num_workers,) + r.shape), residuals
+            )
+        return TrainState(
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=self.optimizer.init(params),
+            residuals=residuals,
+            step=jnp.asarray(0, jnp.int32),
+        )
+
+    def _build(self, has_residuals: bool):
+        worker_step = make_worker_step(self.loss_fn, self.optimizer, self.exchanger)
+        axis = self.axis_name
+
+        def spmd(state_nores, residuals, batch, key):
+            if residuals is not None:
+                residuals = jax.tree_util.tree_map(lambda r: r[0], residuals)
+            state = dataclasses.replace(state_nores, residuals=residuals)
+            new_state, loss, wire = worker_step(state, batch, key)
+            new_res = new_state.residuals
+            if new_res is not None:
+                new_res = jax.tree_util.tree_map(lambda r: r[None], new_res)
+            return dataclasses.replace(new_state, residuals=None), new_res, loss, wire
+
+        res_spec = P(axis) if has_residuals else P()
+        from jax.experimental.shard_map import shard_map
+
+        fn = shard_map(
+            spmd,
+            mesh=self.mesh,
+            in_specs=(P(), res_spec, P(axis), P()),
+            out_specs=(P(), res_spec, P(), P()),
+            check_rep=False,
+        )
+        return jax.jit(fn)
+
+    def step(self, state: TrainState, batch, key: jax.Array):
+        """One synchronous DP step. batch's leading dim is the global batch,
+        split over the data axis."""
+        if self._step_fn is None:
+            self._step_fn = self._build(state.residuals is not None)
+        state_nores = dataclasses.replace(state, residuals=None)
+        new_nores, new_res, loss, wire = self._step_fn(
+            state_nores, state.residuals, batch, key
+        )
+        return dataclasses.replace(new_nores, residuals=new_res), loss, wire

@@ -181,9 +181,9 @@ class TensorCodec:
     # ------------------------------------------------------------------ #
 
     def wire_stats(self, payload: Any) -> WireStats:
-        dense_bits = jnp.asarray(self.d, jnp.int64) * 32
+        dense_bits = jnp.asarray(self.d * 32, jnp.float32)
         if not self.compressed:
-            nnz = payload.nnz.astype(jnp.int64)
+            nnz = payload.nnz.astype(jnp.float32)
             idx_bits = nnz * 32
             val_bits = nnz * 32
         elif self.cfg.deepreduce == "value":
@@ -195,10 +195,10 @@ class TensorCodec:
         else:
             idx_bits = self.idx_codec.index_wire_bits(payload.index_payload)
             if payload.mapping is not None:
-                idx_bits = idx_bits + packing.wire_bits(payload.mapping).astype(jnp.int64)
+                idx_bits = idx_bits + packing.wire_bits(payload.mapping).astype(jnp.float32)
             val_bits = self.val_codec.value_wire_bits(payload.value_payload)
         return WireStats(
-            index_bits=jnp.asarray(idx_bits, jnp.int64),
-            value_bits=jnp.asarray(val_bits, jnp.int64),
+            index_bits=jnp.asarray(idx_bits, jnp.float32),
+            value_bits=jnp.asarray(val_bits, jnp.float32),
             dense_bits=dense_bits,
         )

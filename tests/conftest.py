@@ -4,7 +4,15 @@ pod — the multi-host simulation the reference's MPI-only world couldn't do
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the ambient environment pins JAX_PLATFORMS (e.g. axon);
+# backends initialize lazily, so this works even though pytest plugins may
+# have already imported jax
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert not jax._src.xla_bridge._backends, "jax backend initialized before conftest"

@@ -1,0 +1,202 @@
+"""Value codecs: polyfit fit quality, qsgd error bounds, doubleexp on true
+double-exp curves, gzip losslessness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepreduce_tpu import sparse
+from deepreduce_tpu.codecs import doubleexp, gzip_codec, polyfit, qsgd
+
+
+def _topk_sp(d=50000, ratio=0.01, seed=0):
+    rng = np.random.default_rng(seed)
+    g = rng.normal(size=d).astype(np.float32)
+    return g, sparse.topk(jnp.asarray(g), ratio)
+
+
+# ----------------------------- polyfit ---------------------------------- #
+
+
+def test_polyfit_round_trip_error_small():
+    g, sp = _topk_sp()
+    meta = polyfit.PolyFitMeta(k=sp.k)
+    payload = polyfit.encode(sp, meta)
+    out = polyfit.decode(payload, meta, sp.shape)
+    # decoded values are in descending sorted order; compare to sorted truth
+    want = np.sort(np.asarray(sp.values))[::-1]
+    got = np.asarray(out.values)
+    rms = np.sqrt(np.mean((got - want) ** 2))
+    scale = np.sqrt(np.mean(want**2))
+    assert rms / scale < 0.05, rms / scale
+    # indices carry the sort mapping: scattered values land at true positions
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(payload.indices)), np.sort(np.asarray(sp.indices))
+    )
+
+
+def test_polyfit_segments_match_reference_shape():
+    # int(num_pos*r) > 30 gate, pos/neg split (pytorch/deepreduce.py:362-377)
+    sizes = np.asarray(polyfit.segment_sizes(1000, jnp.asarray(600)))
+    num_pos, num_neg = 600, 400
+    want_pos = [int(num_pos * r) for r in polyfit.RATIOS if int(num_pos * r) > 30]
+    want_neg = [int(num_neg * r) for r in polyfit.RATIOS if int(num_neg * r) > 30]
+    active = sizes[sizes > 0]
+    want = want_pos[::-1] + [num_pos - sum(want_pos)] + [num_neg - sum(want_neg)] + want_neg
+    np.testing.assert_array_equal(active, [w for w in want if w > 0])
+    assert sizes.sum() == 1000
+
+
+def test_polyfit_all_positive_and_all_negative():
+    for sign in (+1.0, -1.0):
+        vals = np.sort(np.random.default_rng(1).gamma(2.0, size=500)).astype(np.float32) * sign
+        sp = sparse.SparseGrad(
+            values=jnp.asarray(vals),
+            indices=jnp.arange(500, dtype=jnp.int32),
+            nnz=jnp.asarray(500, jnp.int32),
+            shape=(5000,),
+        )
+        meta = polyfit.PolyFitMeta(k=500)
+        out = polyfit.decode(polyfit.encode(sp, meta), meta, sp.shape)
+        want = np.sort(vals)[::-1]
+        rms = np.sqrt(np.mean((np.asarray(out.values) - want) ** 2))
+        assert rms / (np.abs(want).mean() + 1e-9) < 0.1
+
+
+def test_polyfit_wire_bits_much_smaller_than_values():
+    g, sp = _topk_sp()
+    meta = polyfit.PolyFitMeta(k=sp.k)
+    payload = polyfit.encode(sp, meta)
+    assert int(polyfit.wire_bits(payload, meta)) < sp.k * 32 * 0.2
+
+
+# ------------------------------ qsgd ------------------------------------ #
+
+
+def test_qsgd_error_bound_and_layout():
+    g, sp = _topk_sp(seed=2)
+    meta = qsgd.QSGDMeta(k=sp.k)
+    payload = qsgd.encode(sp, meta, jax.random.PRNGKey(0))
+    assert payload.data.shape == (meta.payload_len,)
+    out = qsgd.decode(payload, meta, sp.shape)
+    vals = np.asarray(sp.values)
+    got = np.asarray(out.values)
+    # per-bucket error bound: |err| <= norm/quantum per element
+    for b in range(meta.num_buckets):
+        lo, hi = b * meta.bucket_size, min((b + 1) * meta.bucket_size, sp.k)
+        norm = np.linalg.norm(vals[lo:hi])
+        assert np.max(np.abs(got[lo:hi] - vals[lo:hi])) <= norm / meta.quantum_num + 1e-6
+
+
+def test_qsgd_stochastic_rounding_unbiased():
+    vals = jnp.full((512,), 0.3)
+    sp = sparse.SparseGrad(
+        values=vals,
+        indices=jnp.arange(512, dtype=jnp.int32),
+        nnz=jnp.asarray(512, jnp.int32),
+        shape=(512,),
+    )
+    meta = qsgd.QSGDMeta(k=512)
+    outs = []
+    for i in range(20):
+        payload = qsgd.encode(sp, meta, jax.random.PRNGKey(i))
+        outs.append(np.asarray(qsgd.decode(payload, meta, sp.shape).values))
+    mean = np.mean(np.stack(outs))
+    assert abs(mean - 0.3) < 0.005
+
+
+def test_qsgd_norm_bytes_survive_wire():
+    # int8 bitcast round trip of the f32 norm must be exact
+    g, sp = _topk_sp(seed=3)
+    meta = qsgd.QSGDMeta(k=sp.k)
+    payload = qsgd.encode(sp, meta, jax.random.PRNGKey(0))
+    rows = np.asarray(payload.data).reshape(meta.num_buckets, meta.bucket_size + 4)
+    norms = np.frombuffer(rows[:, -4:].astype(np.int8).tobytes(), "<f4")
+    vals = np.asarray(sp.values)
+    for b in range(meta.num_buckets):
+        lo, hi = b * meta.bucket_size, min((b + 1) * meta.bucket_size, sp.k)
+        np.testing.assert_allclose(norms[b], np.linalg.norm(vals[lo:hi]), rtol=1e-6)
+
+
+# ---------------------------- doubleexp --------------------------------- #
+
+
+def _doubleexp_oracle_f64(y):
+    """The reference's integral-equation fit in float64
+    (tensorflow/deepreduce.py:67-144) as a numpy oracle."""
+    k = len(y)
+    x = np.arange(1, k + 1, dtype=np.float64)
+
+    def cumtrapz(f):
+        seg = 0.5 * (f[1:] + f[:-1])
+        return np.concatenate([[0.0], np.cumsum(seg)])
+
+    s = cumtrapz(y)
+    ss = cumtrapz(s)
+    a_mat = np.array(
+        [
+            [np.sum(ss * ss), np.sum(ss * s), np.sum(ss * x), np.sum(ss)],
+            [np.sum(ss * s), np.sum(s * s), np.sum(s * x), np.sum(s)],
+            [np.sum(ss * x), np.sum(s * x), np.sum(x * x), np.sum(x)],
+            [np.sum(ss), np.sum(s), np.sum(x), float(k)],
+        ]
+    )
+    b = np.array([np.sum(ss * y), np.sum(s * y), np.sum(x * y), np.sum(y)])
+    sol = np.linalg.solve(a_mat, b)
+    root = np.sqrt(max(sol[1] ** 2 + 4 * sol[0], 0.0))
+    p, q = 0.5 * (sol[1] + root), 0.5 * (sol[1] - root)
+    beta, eta = np.exp(p * x), np.exp(q * x)
+    m = np.array([[np.sum(beta * beta), np.sum(beta * eta)], [np.sum(beta * eta), np.sum(eta * eta)]])
+    amp = np.linalg.solve(m, np.array([np.sum(beta * y), np.sum(eta * y)]))
+    return amp[0] * beta + amp[1] * eta
+
+
+def test_doubleexp_recovers_true_double_exponential():
+    k = 2000
+    x = np.arange(1, k + 1, dtype=np.float64)
+    y = 0.5 * np.exp(-0.002 * x) + 0.1 * np.exp(-0.0005 * x)
+    sp = sparse.SparseGrad(
+        values=jnp.asarray(y[::-1].astype(np.float32)),  # ascending for sort
+        indices=jnp.arange(k, dtype=jnp.int32),
+        nnz=jnp.asarray(k, jnp.int32),
+        shape=(k * 10,),
+    )
+    meta = doubleexp.DoubleExpMeta(k=k)
+    payload = doubleexp.encode(sp, meta)
+    out = doubleexp.decode(payload, meta, sp.shape)
+    got = np.asarray(out.values)
+    want = np.sort(y)  # ascending |v|
+    # parity: our f32 on-device fit tracks the reference's f64 algorithm
+    oracle = _doubleexp_oracle_f64(want)
+    rel_oracle = np.abs(got - oracle) / (np.abs(oracle) + 1e-9)
+    assert np.median(rel_oracle) < 0.05, np.median(rel_oracle)
+    # and the algorithm itself is a decent fit of the true curve
+    rel_truth = np.abs(got - want) / (np.abs(want) + 1e-9)
+    assert np.median(rel_truth) < 0.15, np.median(rel_truth)
+
+
+def test_doubleexp_signs_ride_indices():
+    g, sp = _topk_sp(seed=4, d=20000)
+    meta = doubleexp.DoubleExpMeta(k=sp.k)
+    payload = doubleexp.encode(sp, meta)
+    out = doubleexp.decode(payload, meta, sp.shape)
+    # positions recovered exactly; value signs match the true gradient signs
+    got_idx = np.asarray(out.indices)
+    want_sign = np.sign(g[got_idx])
+    got_sign = np.sign(np.asarray(out.values))
+    agree = np.mean(want_sign == got_sign)
+    assert agree > 0.99
+    assert set(got_idx.tolist()) == set(np.asarray(sp.indices).tolist())
+
+
+# ------------------------------ gzip ------------------------------------ #
+
+
+def test_gzip_lossless_round_trip():
+    g, sp = _topk_sp(seed=5, d=20000)
+    meta = gzip_codec.GzipMeta(k=sp.k)
+    payload = gzip_codec.encode(sp, meta)
+    out = gzip_codec.decode(payload, meta, sp.shape)
+    np.testing.assert_array_equal(np.asarray(out.values), np.asarray(sp.values))
+    np.testing.assert_array_equal(np.asarray(out.indices), np.asarray(sp.indices))

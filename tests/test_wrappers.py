@@ -1,0 +1,133 @@
+"""Composition-layer tests: value/index/both modes, the idxs[mapping]
+recombination, small-tensor bypass, wire accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepreduce_tpu.config import DeepReduceConfig, from_params
+from deepreduce_tpu.wrappers import TensorCodec
+
+
+def _grad(d=30000, seed=0):
+    return np.random.default_rng(seed).normal(size=d).astype(np.float32)
+
+
+def _run(cfg, g, step=0):
+    codec = TensorCodec(g.shape, cfg, name="t")
+    key = jax.random.PRNGKey(0)
+    payload = codec.encode(jnp.asarray(g), step=step, key=key)
+    dense = np.asarray(codec.decode(payload, step=step)).reshape(-1)
+    stats = codec.wire_stats(payload)
+    return codec, payload, dense, stats
+
+
+def test_mode_none_plain_topk():
+    g = _grad()
+    cfg = DeepReduceConfig(deepreduce=None, compress_ratio=0.01)
+    codec, payload, dense, stats = _run(cfg, g)
+    k = codec.k
+    want_idx = np.argsort(-np.abs(g))[:k]
+    np.testing.assert_allclose(dense[want_idx], g[want_idx], rtol=1e-6)
+    assert float(stats.rel_volume()) == pytest.approx(2 * k * 32 / (g.size * 32))
+
+
+def test_mode_value_polyfit():
+    g = _grad(seed=1)
+    cfg = DeepReduceConfig(deepreduce="value", value="polyfit", compress_ratio=0.01)
+    codec, payload, dense, stats = _run(cfg, g)
+    k = codec.k
+    want_idx = np.argsort(-np.abs(g))[:k]
+    # fitted values land at the true top-k positions with small error
+    err = np.abs(dense[want_idx] - g[want_idx])
+    assert np.median(err) < 0.2 * np.abs(g[want_idx]).mean()
+    assert float(stats.val_rel_volume()) < 0.01 * 32 / 32 * 0.5  # coeffs << raw values
+
+
+def test_mode_index_bloom_fp_aware():
+    g = _grad(seed=2)
+    cfg = DeepReduceConfig(deepreduce="index", index="bloom", compress_ratio=0.01, fpr=0.01)
+    codec, payload, dense, stats = _run(cfg, g)
+    # FP-aware contract: every nonzero of the reconstruction equals the dense value
+    nz = np.flatnonzero(dense)
+    np.testing.assert_allclose(dense[nz], g[nz], rtol=1e-6)
+    # bloom index bits beat raw 32-bit indices
+    assert float(stats.idx_rel_volume()) < codec.k * 32 / (g.size * 32)
+
+
+@pytest.mark.parametrize("value_codec", ["polyfit", "qsgd"])
+def test_mode_both_recombination(value_codec):
+    g = _grad(seed=3)
+    cfg = DeepReduceConfig(
+        deepreduce="both", index="bloom", value=value_codec, compress_ratio=0.01, fpr=0.001
+    )
+    codec, payload, dense, stats = _run(cfg, g)
+    nz = np.flatnonzero(dense)
+    assert len(nz) > 0.9 * codec.k
+    if value_codec == "qsgd":
+        # lossy but bounded: per-bucket bound well under value scale
+        err = np.abs(dense[nz] - g[nz])
+        assert np.max(err) < 0.5
+    else:
+        # polyfit: values at reconstructed positions approximate dense values
+        err = np.abs(dense[nz] - g[nz])
+        assert np.median(err) < 0.25 * np.abs(g[nz]).mean()
+    # total volume well below raw sparse (idx+val raw = 2*k*32 bits)
+    assert float(stats.total_bits) < 2 * codec.k * 32
+
+
+def test_both_qsgd_elides_mapping():
+    g = _grad(seed=4)
+    cfg = DeepReduceConfig(deepreduce="both", index="bloom", value="qsgd", compress_ratio=0.01)
+    codec = TensorCodec(g.shape, cfg)
+    payload = codec.encode(jnp.asarray(g), key=jax.random.PRNGKey(0))
+    assert payload.mapping is None  # order-preserving value codec
+
+
+def test_small_tensor_bypass():
+    g = _grad(d=500, seed=5)
+    cfg = DeepReduceConfig(deepreduce="both", compress_ratio=0.1)
+    codec = TensorCodec(g.shape, cfg)
+    assert not codec.compressed
+    payload = codec.encode(jnp.asarray(g), key=jax.random.PRNGKey(0))
+    dense = np.asarray(codec.decode(payload)).reshape(-1)
+    k = codec.k
+    want_idx = np.argsort(-np.abs(g))[:k]
+    np.testing.assert_allclose(dense[want_idx], g[want_idx], rtol=1e-6)
+
+
+def test_from_params_reference_keys():
+    cfg = from_params(
+        {
+            "compressor": "topk",
+            "compress_ratio": 0.01,
+            "memory": "residual",
+            "communicator": "allgather",
+            "deepreduce": "both",
+            "value": "qsgd",
+            "index": "bloom",
+            "fpr": 0.6,
+            "policy": "p0",
+            "quantum_num": 127,
+            "bucket_size": 512,
+            "micro-benchmark": True,
+            "unknown_key": 42,
+        }
+    )
+    assert cfg.deepreduce == "both" and cfg.policy == "p0" and cfg.fpr == 0.6
+    assert cfg.micro_benchmark is True
+
+
+def test_encode_decode_jit_stable():
+    g = _grad(seed=6)
+    cfg = DeepReduceConfig(deepreduce="both", index="bloom", value="polyfit", compress_ratio=0.01)
+    codec = TensorCodec(g.shape, cfg)
+    enc = jax.jit(lambda t, s, k: codec.encode(t, step=s, key=k))
+    dec = jax.jit(lambda p, s: codec.decode(p, step=s))
+    key = jax.random.PRNGKey(0)
+    p1 = enc(jnp.asarray(g), 0, key)
+    p2 = enc(jnp.asarray(g * 1.5), 1, key)
+    d1 = dec(p1, 0)
+    d2 = dec(p2, 1)
+    assert d1.shape == d2.shape

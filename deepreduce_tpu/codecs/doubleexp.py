@@ -41,10 +41,15 @@ class DoubleExpPayload:
 
 
 def _fit(y: jax.Array) -> jax.Array:
-    """Integral-method fit of a·e^{p·x}+c·e^{q·x} to y over x=1..K
-    (tensorflow/deepreduce.py:67-144)."""
+    """Integral-method fit of a·e^{p·x}+c·e^{q·x} to y
+    (tensorflow/deepreduce.py:67-144). The reference runs it in float64 over
+    x=1..K; in f32 the normal-matrix entries (~K^3) cancel catastrophically,
+    so we exploit the method's scale covariance and fit over x = i/K in
+    (0, 1] — entries stay O(K) and f32 suffices. The stored exponents are in
+    normalized units; `_eval` uses the same grid, so the wire format is
+    self-consistent."""
     k = y.shape[0]
-    x = jnp.arange(1, k + 1, dtype=jnp.float32)
+    x = jnp.arange(1, k + 1, dtype=jnp.float32) / jnp.float32(k)
 
     def cumtrapz(f):
         seg = 0.5 * (f[1:] + f[:-1]) * (x[1:] - x[:-1])
@@ -80,11 +85,9 @@ def _fit(y: jax.Array) -> jax.Array:
     root = jnp.sqrt(disc)
     p = 0.5 * (sol[1] + root)
     q = 0.5 * (sol[1] - root)
-    # exponents are tiny negatives/positives on sorted grad curves; clamp so
-    # e^{p·K} cannot overflow f32 during the amplitude solve
-    cap = 80.0 / jnp.float32(max(k, 1))
-    p = jnp.clip(p, -cap, cap)
-    q = jnp.clip(q, -cap, cap)
+    # clamp so e^{p·x} with x in (0,1] cannot overflow f32
+    p = jnp.clip(p, -80.0, 80.0)
+    q = jnp.clip(q, -80.0, 80.0)
 
     beta = jnp.exp(p * x)
     eta = jnp.exp(q * x)
@@ -98,7 +101,7 @@ def _fit(y: jax.Array) -> jax.Array:
 
 
 def _eval(coeffs: jax.Array, k: int) -> jax.Array:
-    x = jnp.arange(1, k + 1, dtype=jnp.float32)
+    x = jnp.arange(1, k + 1, dtype=jnp.float32) / jnp.float32(k)
     a, c, p, q = coeffs[0], coeffs[1], coeffs[2], coeffs[3]
     return a * jnp.exp(p * x) + c * jnp.exp(q * x)
 

@@ -98,10 +98,11 @@ def _encode_host(idx_bytes: np.ndarray, d: int, budget: int) -> Tuple[np.ndarray
     if (total + 7) // 8 > budget:
         raise ValueError("huffman payload exceeds static budget")
     max_len = int(lens.max()) if lens.size else 1
-    # MSB-first bits of each code, gathered into one stream
+    # MSB-first bits of each code in a [n, max_len] lane grid; symbol i's
+    # valid bits are the last `lens[i]` lanes (lane m holds bit
+    # (code >> (max_len-1-m)) & 1, so the MSB sits at lane max_len-lens[i])
     shifts = np.arange(max_len - 1, -1, -1, dtype=np.uint64)
-    bits_mat = (codes[idx_bytes, None] >> np.maximum(shifts[None, :] - (max_len - lens)[:, None], 0)) & 1
-    # per symbol, the valid bits are the *last* `len` lanes of its max_len row
+    bits_mat = (codes[idx_bytes][:, None] >> shifts[None, :]) & 1
     lane = np.arange(max_len)[None, :]
     valid = lane >= (max_len - lens[:, None])
     flat_bits = bits_mat[valid].astype(np.uint8)

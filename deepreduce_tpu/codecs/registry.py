@@ -103,7 +103,11 @@ class BloomCodec(Codec):
     def __init__(self, k, d, params=None):
         super().__init__(k, d, params)
         self.meta = bloom.BloomMeta.create(
-            k, d, fpr=self.params.get("fpr"), policy=self.params.get("policy", "leftmost")
+            k,
+            d,
+            fpr=self.params.get("fpr"),
+            policy=self.params.get("policy", "leftmost"),
+            blocked=bool(self.params.get("bloom_blocked", False)),
         )
         self.seed = int(self.params.get("seed", 0))
 

@@ -33,6 +33,8 @@ class DeepReduceConfig:
     # codec knobs
     fpr: Optional[float] = None  # default 0.1*k/d (pytorch/deepreduce.py:511)
     policy: str = "leftmost"  # leftmost | random | p0 | conflict_sets(native)
+    bloom_blocked: bool = False  # register-blocked filter: 1 gather/query
+    # instead of num_hash — the TPU fast path (~1.5x filter size for equal FPR)
     poly_degree: int = 5
     quantum_num: int = 127
     bucket_size: int = 512
@@ -47,6 +49,7 @@ class DeepReduceConfig:
         return {
             "fpr": self.fpr,
             "policy": self.policy,
+            "bloom_blocked": self.bloom_blocked,
             "poly_degree": self.poly_degree,
             "quantum_num": self.quantum_num,
             "bucket_size": self.bucket_size,

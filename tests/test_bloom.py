@@ -128,3 +128,35 @@ def test_wire_bits_smaller_than_raw_indices():
     raw_idx_bits = sp.k * 32
     bloom_idx_bits = int(bloom.wire_bits(payload, meta)) - int(payload.nsel) * 32
     assert bloom_idx_bits < raw_idx_bits  # the -33% claim territory (BASELINE.md)
+
+
+# ---------------------- blocked (TPU fast path) -------------------------- #
+
+
+@pytest.mark.parametrize("fpr", [0.05, 0.01, 0.001])
+def test_blocked_no_false_negatives_and_fpr(fpr):
+    rng = np.random.default_rng(10)
+    d = 100000
+    g = rng.normal(size=d).astype(np.float32)
+    sp = sparse.topk(jnp.asarray(g), 0.01)
+    meta = bloom.BloomMeta.create(sp.k, d, fpr=fpr, blocked=True)
+    words = bloom.insert(sp.indices, sp.nnz, meta)
+    mask = np.asarray(bloom.query_universe(words, meta))
+    assert mask[np.asarray(sp.indices)].all()
+    measured = float(bloom.measured_fpr(sp, words, meta))
+    # Poisson-calibrated geometry should land at or under ~1.5x target
+    assert measured <= fpr * 1.5 + 1e-4, (fpr, measured)
+
+
+@pytest.mark.parametrize("policy", ["leftmost", "random", "p0"])
+def test_blocked_encode_decode_agree(policy):
+    rng = np.random.default_rng(11)
+    d = 50000
+    g = rng.normal(size=d).astype(np.float32)
+    sp = sparse.topk(jnp.asarray(g), 0.01)
+    meta = bloom.BloomMeta.create(sp.k, d, fpr=0.01, policy=policy, blocked=True)
+    payload = bloom.encode(sp, jnp.asarray(g), meta, step=9)
+    out = bloom.decode(payload, meta, sp.shape, step=9)
+    nsel = int(out.nnz)
+    sel = np.asarray(out.indices)[:nsel]
+    np.testing.assert_allclose(np.asarray(payload.values)[:nsel], g[sel], rtol=1e-6)

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Benchmark sweep driver — the run_deepreduce.sh role, minus MPI/Horovod:
+# the "cluster" is the device mesh, so no mpirun, no host lists, no NCCL
+# socket pinning. Each block mirrors a reference experiment family.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+STEPS=${STEPS:-20}
+PY=${PY:-python}
+
+echo "== dense baseline (allreduce) =="
+$PY benchmarks/train.py --model resnet20 --num_steps $STEPS \
+  --grace_config "{'compressor':'none','memory':'none','communicator':'allreduce'}"
+
+echo "== Top-r 1% + residual (plain sparsification) =="
+$PY benchmarks/train.py --model resnet20 --num_steps $STEPS \
+  --grace_config "{'compressor':'topk','compress_ratio':0.01,'memory':'residual','communicator':'allgather'}"
+
+echo "== DR*BF (index bloom, fp-aware) =="
+$PY benchmarks/train.py --model resnet20 --num_steps $STEPS \
+  --grace_config "{'compressor':'topk','compress_ratio':0.01,'memory':'residual','communicator':'allgather','deepreduce':'index','index':'bloom','fpr':0.001,'policy':'leftmost'}"
+
+echo "== DRFit-Poly (value polyfit) =="
+$PY benchmarks/train.py --model resnet20 --num_steps $STEPS \
+  --grace_config "{'compressor':'topk','compress_ratio':0.01,'memory':'residual','communicator':'allgather','deepreduce':'value','value':'polyfit'}"
+
+echo "== DRQSGD-BF-P0 (the paper's headline combo) =="
+$PY benchmarks/train.py --model resnet20 --num_steps $STEPS \
+  --grace_config "{'compressor':'topk','compress_ratio':0.01,'memory':'residual','communicator':'allgather','deepreduce':'both','index':'bloom','value':'qsgd','fpr':0.01,'policy':'p0','quantum_num':127,'bucket_size':512}"
+
+echo "== NCF natively-sparse (threshold 0, value qsgd, FPR 0.6 P0: paper Table 6) =="
+$PY benchmarks/train.py --model ncf --num_steps $STEPS --batch_size 256 \
+  --grace_config "{'compressor':'threshold','threshold':0.0,'compress_ratio':0.01,'memory':'residual','communicator':'allgather','deepreduce':'both','index':'bloom','value':'qsgd','fpr':0.6,'policy':'p0'}"
+
+echo "== BERT-base allgather stress (new config, BASELINE.json #5) =="
+$PY benchmarks/train.py --model bert --num_steps 3 --batch_size 8 \
+  --grace_config "{'compressor':'topk','compress_ratio':0.001,'memory':'residual','communicator':'allgather','deepreduce':'both','index':'bloom','value':'polyfit','fpr':0.001,'bloom_blocked':True}"

@@ -1,0 +1,223 @@
+"""Benchmark training driver — the L6 layer (run_deepreduce.sh +
+tf_cnn_benchmarks / trainer_grace / ncf_grace role, SURVEY.md §1).
+
+One driver for every model family, configured exactly like the reference:
+a ``--grace_config`` Python-literal dict with the reference's key names
+(run_deepreduce.sh:35):
+
+    python benchmarks/train.py --model resnet20 --num_steps 100 \
+      --grace_config "{'compressor':'topk','compress_ratio':0.01,
+                       'memory':'residual','communicator':'allgather',
+                       'deepreduce':'both','index':'bloom','value':'polyfit',
+                       'fpr':0.001,'policy':'leftmost'}"
+
+Data is synthetic (shape-correct random batches): this driver measures the
+framework — step time, wire volume, convergence mechanics — not dataset
+accuracy (no dataset egress in this environment). Plug a real data iterator
+into `run` for accuracy work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+
+MODELS = {}
+
+
+def _register(name):
+    def deco(fn):
+        MODELS[name] = fn
+        return fn
+
+    return deco
+
+
+@_register("resnet20")
+def _resnet20():
+    from deepreduce_tpu.models import ResNet20
+
+    return ResNet20(), ("image", (32, 32, 3), 10)
+
+
+@_register("densenet40")
+def _densenet40():
+    from deepreduce_tpu.models import DenseNet40
+
+    return DenseNet40(), ("image", (32, 32, 3), 10)
+
+
+@_register("mobilenet")
+def _mobilenet():
+    from deepreduce_tpu.models import MobileNetV1
+
+    return MobileNetV1(), ("image", (32, 32, 3), 10)
+
+
+@_register("resnet50")
+def _resnet50():
+    from deepreduce_tpu.models import ResNet50
+
+    return ResNet50(), ("image", (224, 224, 3), 1000)
+
+
+@_register("ncf")
+def _ncf():
+    from deepreduce_tpu.models import NeuMF
+
+    return NeuMF(), ("ncf", None, None)
+
+
+@_register("lstm")
+def _lstm():
+    from deepreduce_tpu.models import WordLSTM
+
+    m = WordLSTM()
+    return m, ("lm", 20, m.vocab_size)
+
+
+@_register("bert")
+def _bert():
+    from deepreduce_tpu.models import BertEncoder
+
+    m = BertEncoder()
+    return m, ("lm", 128, m.vocab_size)
+
+
+def make_batch(kind, spec, classes, batch, rng, model=None):
+    import jax.numpy as jnp
+
+    if kind == "image":
+        x = jnp.asarray(rng.normal(size=(batch,) + spec).astype(np.float32))
+        y = jnp.asarray(rng.integers(0, classes, size=batch), jnp.int32)
+        return (x, y)
+    if kind == "lm":
+        seq = spec
+        toks = jnp.asarray(rng.integers(0, classes, size=(batch, seq)), jnp.int32)
+        return (toks,)  # labels derived (next-token) in the loss
+    if kind == "ncf":
+        users = jnp.asarray(rng.integers(0, model.num_users, size=batch), jnp.int32)
+        items = jnp.asarray(rng.integers(0, model.num_items, size=batch), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, 2, size=batch).astype(np.float32))
+        return ((users, items), labels)
+    raise ValueError(kind)
+
+
+def make_loss(kind, model):
+    import jax.numpy as jnp
+    import optax
+
+    if kind == "image":
+        from deepreduce_tpu.train import classification_loss
+
+        return classification_loss(model)
+
+    if kind == "lm":
+
+        def loss_fn(params, batch_stats, batch):
+            (toks,) = batch
+            logits = model.apply({"params": params}, toks[:, :-1])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, toks[:, 1:]
+            ).mean()
+            return loss, batch_stats
+
+        return loss_fn
+
+    if kind == "ncf":
+
+        def loss_fn(params, batch_stats, batch):
+            (users, items), labels = batch
+            logits = model.apply({"params": params}, users, items)
+            loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+            return loss, batch_stats
+
+        return loss_fn
+
+    raise ValueError(kind)
+
+
+def run(args) -> dict:
+    import jax
+    import optax
+    from jax.sharding import Mesh
+
+    from deepreduce_tpu.config import from_params
+    from deepreduce_tpu.train import Trainer
+
+    params = ast.literal_eval(args.grace_config) if args.grace_config else {}
+    cfg = from_params(params)
+    model, (kind, spec, classes) = MODELS[args.model]()
+
+    n_dev = min(args.num_workers, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
+    trainer = Trainer(
+        model, cfg, optax.sgd(args.learning_rate, momentum=0.9), mesh,
+        loss_fn=make_loss(kind, model),
+    )
+
+    rng = np.random.default_rng(0)
+    batch = make_batch(kind, spec, classes, args.batch_size, rng, model=model)
+    if kind == "ncf":
+        sample = (batch[0], batch[1])
+        init_batch = (batch[0], batch[1])
+    else:
+        init_batch = batch
+    state = trainer.init_state(jax.random.PRNGKey(args.seed), init_batch)
+
+    key = jax.random.PRNGKey(args.seed + 1)
+    losses = []
+    t0 = time.perf_counter()
+    for step in range(args.num_steps):
+        batch = make_batch(kind, spec, classes, args.batch_size, rng, model=model)
+        state, loss, wire = trainer.step(state, batch, jax.random.fold_in(key, step))
+        losses.append(float(loss))
+        if args.log_every and step % args.log_every == 0:
+            print(
+                f"step {step} loss {losses[-1]:.4f} "
+                f"rel_volume {float(wire.rel_volume()):.4f}"
+            )
+    elapsed = time.perf_counter() - t0
+
+    result = {
+        "model": args.model,
+        "workers": n_dev,
+        "steps": args.num_steps,
+        "global_batch": args.batch_size,
+        "first_loss": losses[0],
+        "last_loss": losses[-1],
+        "examples_per_sec": args.batch_size * args.num_steps / elapsed,
+        "rel_volume": float(wire.rel_volume()),
+        "idx_rel_volume": float(wire.idx_rel_volume()),
+        "val_rel_volume": float(wire.val_rel_volume()),
+        "payload_bytes_per_step": trainer.exchanger.payload_bytes(state.params),
+        "config": params,
+    }
+    print(json.dumps(result))
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=sorted(MODELS), default="resnet20")
+    ap.add_argument("--grace_config", type=str, default="")
+    ap.add_argument("--num_steps", type=int, default=20)
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--num_workers", type=int, default=8)
+    ap.add_argument("--learning_rate", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--log_every", type=int, default=5)
+    run(ap.parse_args())
+
+
+if __name__ == "__main__":
+    main()

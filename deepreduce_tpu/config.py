@@ -20,6 +20,7 @@ class DeepReduceConfig:
     compressor: str = "topk"  # topk | randomk | threshold | none
     compress_ratio: float = 0.01
     threshold_val: float = 0.0
+    approx_topk: bool = False  # TPU-native approx_max_k sparsifier (~4x faster)
     # residual error-feedback (GRACE 'memory' role)
     memory: str = "residual"  # residual | none
     beta: float = 1.0

@@ -1,0 +1,84 @@
+"""Verbosity-gated debug/metrics dump subsystem.
+
+Reference parity: the C++ `CompressionUtilities` logging layer writes
+`fpr.txt`, `policy_errors.txt`, `stats.txt` and full bit-array/values dumps
+under ``bloom_logs_path/<rank>/step_<s>/<gradient_id>/``
+(compression_utils.hpp:96-176), and the `Logger` TF op dumps the full
+gradient (`values.csv`) and fit coefficients (`coefficients.csv`) per
+rank/step/gradient at a verbosity frequency (logger.cc:37-52).
+
+TPU version: a host-side `DumpLogger` with the same directory scheme and
+file names, driven from *fetched* arrays (numpy) rather than in-kernel
+`system("mkdir -p")` calls — debug dumps have no business inside the jit
+hot loop on TPU. For in-graph use, `attach` wraps it in `jax.debug.callback`
+(CPU/testing only: the axon TPU PJRT has no host callbacks)."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+from typing import Optional
+
+import numpy as np
+
+
+class DumpLogger:
+    """Per (rank, step, gradient_id) dump directory tree, reference layout."""
+
+    def __init__(self, root: str, rank: int = 0, verbosity: int = 0, frequency: int = 1):
+        self.root = pathlib.Path(root)
+        self.rank = rank
+        self.verbosity = verbosity
+        self.frequency = max(1, frequency)
+
+    def enabled(self, step: int) -> bool:
+        return self.verbosity > 0 and step % self.frequency == 0
+
+    def _dir(self, step: int, gradient_id: str) -> pathlib.Path:
+        path = self.root / str(self.rank) / f"step_{step}" / str(gradient_id)
+        path.mkdir(parents=True, exist_ok=True)
+        return path
+
+    def log_fpr(self, step: int, gradient_id: str, configured: float, measured: float) -> None:
+        """fpr.txt (compression_utils.hpp logging_compressor role)."""
+        if not self.enabled(step):
+            return
+        with open(self._dir(step, gradient_id) / "fpr.txt", "a") as f:
+            f.write(f"FalsePositives_Rate: {measured}  (configured: {configured})\n")
+
+    def log_policy_errors(self, step: int, gradient_id: str, errors: int, k: int) -> None:
+        """policy_errors.txt: selected indices not in the true set
+        (policies.hpp:32-41 get_policy_errors)."""
+        if not self.enabled(step):
+            return
+        with open(self._dir(step, gradient_id) / "policy_errors.txt", "a") as f:
+            f.write(f"PolicyErrors: {errors} / {k}\n")
+
+    def log_stats(self, step: int, gradient_id: str, initial_bits: float, final_bits: float) -> None:
+        """stats.txt: Initial_Size/Final_Size in bits
+        (compression_utils.hpp:145-148)."""
+        if not self.enabled(step):
+            return
+        with open(self._dir(step, gradient_id) / "stats.txt", "a") as f:
+            f.write(f"Initial_Size: {int(initial_bits)}   Final_Size: {int(final_bits)}\n")
+
+    def log_values(self, step: int, gradient_id: str, values: np.ndarray) -> None:
+        """values.csv — the Logger op's gradient dump (logger.cc:37-52)."""
+        if not self.enabled(step):
+            return
+        np.savetxt(self._dir(step, gradient_id) / "values.csv", np.asarray(values), delimiter=",")
+
+    def log_coefficients(self, step: int, gradient_id: str, coeffs: np.ndarray) -> None:
+        """coefficients.csv — fit-coefficient dump for offline curve
+        inspection."""
+        if not self.enabled(step):
+            return
+        np.savetxt(
+            self._dir(step, gradient_id) / "coefficients.csv", np.asarray(coeffs), delimiter=","
+        )
+
+
+def policy_errors(selected: np.ndarray, true_indices: np.ndarray) -> int:
+    """How many selected indices are not true sparsifier indices — the
+    diagnostic the C++ policies layer computes (policies.hpp:32-41)."""
+    return int(len(np.setdiff1d(np.asarray(selected), np.asarray(true_indices))))

@@ -76,12 +76,27 @@ def num_slots(dense_size: int, compress_ratio: float) -> int:
     return max(1, int(dense_size * compress_ratio))
 
 
-def topk(tensor: jax.Array, compress_ratio: float, *, sort_indices: bool = True) -> SparseGrad:
+def topk(
+    tensor: jax.Array,
+    compress_ratio: float,
+    *,
+    sort_indices: bool = True,
+    approx: bool = False,
+) -> SparseGrad:
     """Top-k by magnitude. Indices ascending when `sort_indices` (the TF
-    reference sorts, tensorflow/deepreduce.py:276)."""
+    reference sorts, tensorflow/deepreduce.py:276).
+
+    `approx=True` uses `jax.lax.approx_max_k` — the TPU-native top-k
+    (~4x faster at 25M elements, recall ~0.95). Missed elements are exactly
+    what residual error-feedback re-injects next step, so recall<1 trades
+    a little convergence speed for a lot of wall-clock; deterministic, so
+    the encode/decode contract is unaffected."""
     flat = tensor.reshape(-1)
     k = num_slots(flat.shape[0], compress_ratio)
-    _, idxs = jax.lax.top_k(jnp.abs(flat), k)
+    if approx and flat.shape[0] > 4 * k:
+        _, idxs = jax.lax.approx_max_k(jnp.abs(flat), k, recall_target=0.95)
+    else:
+        _, idxs = jax.lax.top_k(jnp.abs(flat), k)
     if sort_indices:
         idxs = jnp.sort(idxs)
     vals = flat[idxs]

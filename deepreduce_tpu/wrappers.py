@@ -96,7 +96,7 @@ class TensorCodec:
     def sparsify(self, tensor: jax.Array, *, key: Optional[jax.Array] = None) -> SparseGrad:
         cfg = self.cfg
         if cfg.compressor == "topk":
-            return sparse.topk(tensor, cfg.compress_ratio)
+            return sparse.topk(tensor, cfg.compress_ratio, approx=cfg.approx_topk)
         if cfg.compressor == "randomk":
             if key is None:
                 raise ValueError("randomk sparsifier needs a PRNG key")

@@ -19,6 +19,8 @@ from deepreduce_tpu.codecs import (
     integer,
     packing,
     polyfit,
+    polyfit_host,
+    polyseg,
     qsgd,
     rle,
 )
@@ -32,6 +34,8 @@ __all__ = [
     "integer",
     "packing",
     "polyfit",
+    "polyfit_host",
+    "polyseg",
     "qsgd",
     "rle",
     "INDEX_CODECS",

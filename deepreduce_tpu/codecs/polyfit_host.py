@@ -1,0 +1,146 @@
+"""Host-path PolyFit variant with searched knots and transmitted breaks.
+
+Reference parity: `PolyFitCPU` (/root/reference/pytorch/deepreduce.py:560-688)
+— unlike the GPU PolyFit (geometric segments re-derived from (N, num_pos)),
+this variant *searches* for knots by recursive max-distance-from-chord
+(`find_breaks` :566-582), fits with numpy per segment, and transmits the
+breaks explicitly alongside the coefficients (:669-675). Positive values are
+knot-searched in reversed (ascending) order, negatives in sorted order, and
+the pos/neg boundary is always a break (:653-665).
+
+Placement: host codec under `pure_callback` (the reference's is CPU numpy
+too); static payload budget = max_breaks segments. The on-device sort and
+the mapping transmission stay in JAX; only the knot search + per-segment
+polyfit round-trips to host."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepreduce_tpu.sparse import SparseGrad
+
+NUM_BREAKS = 5  # reference default (pytorch/deepreduce.py:632)
+MIN_TAIL = 20  # `20 * num_of_breaks` guard (:574,578) scaled per break
+
+
+def find_breaks(curve: np.ndarray, num_breaks: int = NUM_BREAKS) -> list:
+    """Recursive ascending knot search: repeatedly split at the point of
+    max |curve - chord| over the remaining suffix (reference :566-582)."""
+    y = curve
+    breaks = []
+    base = 0
+    for _ in range(num_breaks):
+        if len(y) < MIN_TAIL * num_breaks:
+            break
+        line = np.linspace(y[0], y[-1], len(y))
+        off = int(np.argmax(np.abs(line - y)))
+        base += off
+        if len(curve) - base < MIN_TAIL * num_breaks:
+            break
+        breaks.append(base)
+        y = curve[base:]
+    return breaks
+
+
+def _fit_host(vals_sorted: np.ndarray, degree: int) -> Tuple[np.ndarray, np.ndarray, np.int32]:
+    """Returns (coeffs [S, degree+1] f32, breaks [S+1] i32, n_seg)."""
+    y = vals_sorted.astype(np.float64)
+    num_pos = int(np.sum(y > 0))
+    n = len(y)
+    if num_pos == 0:
+        breaks = find_breaks(y)
+    elif num_pos == n:
+        rev = find_breaks(y[::-1])
+        breaks = sorted(n - b for b in rev)
+    else:
+        rev = find_breaks(y[:num_pos][::-1])
+        breaks_pos = sorted(num_pos - b for b in rev)
+        breaks_neg = [num_pos + b for b in find_breaks(y[num_pos:])]
+        breaks = breaks_pos + [num_pos] + breaks_neg
+    bounds = [0] + sorted(set(b for b in breaks if 0 < b < n)) + [n]
+
+    max_seg = 2 * NUM_BREAKS + 2
+    coeffs = np.zeros((max_seg, degree + 1), np.float32)
+    out_bounds = np.zeros(max_seg + 1, np.int32)
+    n_seg = len(bounds) - 1
+    for s in range(n_seg):
+        lo, hi = bounds[s], bounds[s + 1]
+        x = np.arange(lo, hi, dtype=np.float64)
+        c = np.polynomial.polynomial.polyfit(x, y[lo:hi], min(degree, max(1, hi - lo - 1)))
+        coeffs[s, : len(c)] = c.astype(np.float32)
+        out_bounds[s + 1] = hi
+    out_bounds[n_seg + 1 :] = n
+    return coeffs, out_bounds, np.int32(n_seg)
+
+
+def _eval_host(coeffs: np.ndarray, bounds: np.ndarray, n_seg: int, n: int) -> np.ndarray:
+    y = np.zeros(n, np.float32)
+    for s in range(int(n_seg)):
+        lo, hi = int(bounds[s]), int(bounds[s + 1])
+        x = np.arange(lo, hi, dtype=np.float64)
+        y[lo:hi] = np.polynomial.polynomial.polyval(x, coeffs[s].astype(np.float64)).astype(
+            np.float32
+        )
+    return y
+
+
+@dataclasses.dataclass(frozen=True)
+class PolyFitHostMeta:
+    k: int
+    degree: int = 5
+
+    @property
+    def max_segments(self) -> int:
+        return 2 * NUM_BREAKS + 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PolyFitHostPayload:
+    coeffs: jax.Array  # f32[S, degree+1]
+    bounds: jax.Array  # i32[S+1] — transmitted breaks (reference :670)
+    n_seg: jax.Array  # i32[]
+    indices: jax.Array  # i32[k] — value-sorted order (the mapping)
+
+
+def encode(sp: SparseGrad, meta: PolyFitHostMeta) -> PolyFitHostPayload:
+    order = jnp.argsort(-sp.values)
+    vals = sp.values[order]
+    idxs = sp.indices[order]
+    s = meta.max_segments
+
+    coeffs, bounds, n_seg = jax.pure_callback(
+        lambda v: _fit_host(np.asarray(v), meta.degree),
+        (
+            jax.ShapeDtypeStruct((s, meta.degree + 1), jnp.float32),
+            jax.ShapeDtypeStruct((s + 1,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        ),
+        vals,
+    )
+    return PolyFitHostPayload(coeffs=coeffs, bounds=bounds, n_seg=n_seg, indices=idxs)
+
+
+def decode(payload: PolyFitHostPayload, meta: PolyFitHostMeta, shape: Tuple[int, ...]) -> SparseGrad:
+    vals = jax.pure_callback(
+        lambda c, b, ns: _eval_host(np.asarray(c), np.asarray(b), int(ns), meta.k),
+        jax.ShapeDtypeStruct((meta.k,), jnp.float32),
+        payload.coeffs,
+        payload.bounds,
+        payload.n_seg,
+    )
+    return SparseGrad(
+        values=vals,
+        indices=payload.indices,
+        nnz=jnp.asarray(meta.k, jnp.int32),
+        shape=shape,
+    )
+
+
+def wire_bits(payload: PolyFitHostPayload, meta: PolyFitHostMeta) -> jax.Array:
+    return payload.n_seg.astype(jnp.float32) * ((meta.degree + 1) * 32 + 32) + 32

@@ -29,6 +29,8 @@ from deepreduce_tpu.codecs import (
     huffman,
     integer,
     polyfit,
+    polyfit_host,
+    polyseg,
     qsgd,
     rle,
 )
@@ -328,6 +330,80 @@ class GzipCodec(Codec):
         return _dc.replace(payload, indices=jnp.zeros((0,), jnp.int32)), None, 0
 
 
+class PolyFitHostCodec(Codec):
+    """PolyFitCPU role: searched knots, transmitted breaks, host numpy fit."""
+
+    kind = "value"
+    order_preserving = False
+    fixed_size = False  # break count varies (reference returns a tuple :673)
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = polyfit_host.PolyFitHostMeta(
+            k=k, degree=int(self.params.get("poly_degree", 5))
+        )
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return polyfit_host.encode(sp, self.meta)
+
+    def decode(self, payload, shape, *, step=0):
+        return polyfit_host.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return _raw_value_bits(self.k)
+
+    def value_wire_bits(self, payload):
+        return polyfit_host.wire_bits(payload, self.meta)
+
+
+class PolySegCodec(Codec):
+    """TF PolySegCompressor role: whole-layer sort, in-graph knot search,
+    sign-embedded indices."""
+
+    kind = "value"
+    order_preserving = False
+    fixed_size = True
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = polyseg.PolySegMeta(
+            k=k,
+            degree=int(self.params.get("poly_degree", 5)),
+            num_segments=int(self.params.get("num_segments", 0)),
+        )
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return polyseg.encode(sp, self.meta)
+
+    def decode(self, payload, shape, *, step=0):
+        return polyseg.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return _raw_value_bits(self.k)
+
+    def value_wire_bits(self, payload):
+        return polyseg.wire_bits(payload, self.meta)
+
+    def both_mapping_max(self) -> int:
+        return 2 * self.k
+
+    def strip_for_both(self, payload):
+        import dataclasses as _dc
+
+        mapping = (payload.signed_indices + self.k).astype(jnp.uint32)
+        stripped = _dc.replace(payload, signed_indices=jnp.zeros((0,), jnp.int32))
+        return stripped, mapping, self.both_mapping_max()
+
+    def restore_for_both(self, stripped, mapping):
+        import dataclasses as _dc
+
+        if mapping is None:
+            signed = jnp.arange(1, self.k + 1, dtype=jnp.int32)
+        else:
+            signed = mapping.astype(jnp.int32) - self.k
+        return _dc.replace(stripped, signed_indices=signed)
+
+
 INDEX_CODECS: Dict[str, type] = {
     "bloom": BloomCodec,
     "rle": RLECodec,
@@ -337,6 +413,8 @@ INDEX_CODECS: Dict[str, type] = {
 
 VALUE_CODECS: Dict[str, type] = {
     "polyfit": PolyFitCodec,
+    "polyfit_host": PolyFitHostCodec,
+    "polyseg": PolySegCodec,
     "doubleexp": DoubleExpCodec,
     "qsgd": QSGDCodec,
     "gzip": GzipCodec,

@@ -1,0 +1,73 @@
+"""PolySeg (in-graph knot search) and PolyFitHost (searched knots,
+transmitted breaks) value codecs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepreduce_tpu import sparse
+from deepreduce_tpu.codecs import polyfit_host, polyseg
+
+
+def _sp(d=30000, ratio=0.02, seed=0):
+    rng = np.random.default_rng(seed)
+    g = rng.normal(size=d).astype(np.float32)
+    return g, sparse.topk(jnp.asarray(g), ratio)
+
+
+def test_polyseg_round_trip_quality():
+    g, sp = _sp()
+    meta = polyseg.PolySegMeta(k=sp.k)
+    payload = polyseg.encode(sp, meta)
+    out = polyseg.decode(payload, meta, sp.shape)
+    # indices recovered exactly, signs ride the indices
+    assert set(np.asarray(out.indices).tolist()) == set(np.asarray(sp.indices).tolist())
+    got = np.asarray(out.values)
+    lut = dict(zip(np.asarray(sp.indices).tolist(), np.asarray(sp.values).tolist()))
+    want = np.asarray([lut[i] for i in np.asarray(out.indices).tolist()])
+    assert np.mean(np.sign(got) == np.sign(want)) > 0.99
+    rms = np.sqrt(np.mean((got - want) ** 2))
+    assert rms / (np.abs(want).mean() + 1e-9) < 0.2
+
+
+def test_polyseg_breaks_are_ascending_and_transmitted():
+    g, sp = _sp(seed=1)
+    meta = polyseg.PolySegMeta(k=sp.k, num_segments=4)
+    payload = polyseg.encode(sp, meta)
+    b = np.asarray(payload.breaks)
+    assert b[0] == 0 and b[-1] == sp.k
+    assert np.all(np.diff(b) >= 0)
+    assert payload.coeffs.shape == (4, 6)
+
+
+def test_polyseg_jit():
+    g, sp = _sp(seed=2)
+    meta = polyseg.PolySegMeta(k=sp.k)
+    enc = jax.jit(lambda s: polyseg.encode(s, meta))
+    dec = jax.jit(lambda p: polyseg.decode(p, meta, sp.shape))
+    out = dec(enc(sp))
+    assert out.values.shape == (sp.k,)
+
+
+def test_polyfit_host_round_trip_quality():
+    g, sp = _sp(seed=3)
+    meta = polyfit_host.PolyFitHostMeta(k=sp.k)
+    payload = polyfit_host.encode(sp, meta)
+    out = polyfit_host.decode(payload, meta, sp.shape)
+    want = np.sort(np.asarray(sp.values))[::-1]
+    got = np.asarray(out.values)
+    rms = np.sqrt(np.mean((got - want) ** 2))
+    assert rms / (np.abs(want).mean() + 1e-9) < 0.15
+    # breaks transmitted, pos/neg boundary among them
+    num_pos = int((want > 0).sum())
+    bounds = np.asarray(payload.bounds)[: int(payload.n_seg) + 1]
+    assert num_pos in bounds.tolist()
+
+
+def test_polyfit_host_knot_search_reference_shape():
+    # knot search on a convex curve places breaks away from endpoints
+    y = np.exp(-np.linspace(0, 5, 2000)).astype(np.float64)
+    breaks = polyfit_host.find_breaks(y)
+    assert all(0 < b < 2000 for b in breaks)
+    assert breaks == sorted(breaks)

@@ -1,0 +1,69 @@
+// XLA FFI custom-call wrappers around the native codec library — the true
+// counterpart of the reference's TF custom ops (bloom_filter_compression.cc
+// op registration :19-36): the same host kernels, but registered with XLA's
+// FFI so they appear as custom-calls inside jitted programs on the CPU
+// platform (TPU host offload goes through the same registry).
+//
+// Handlers:
+//   drn_ffi_bloom_query   (bitmap u8[m_bytes], h) -> mask u8[d]
+//   drn_ffi_fbp_decode    (words u32[n]) -> values u32[cap]  (delta-unpacked)
+//   drn_ffi_varint_decode (bytes u8[n])  -> values u32[cap]
+//
+// Build: make -C deepreduce_tpu/native xla (adds -I jaxlib/include).
+
+#include <cstdint>
+#include <cstring>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+// from deepreduce_native.cc
+extern "C" {
+int32_t drn_bloom_query_universe(const uint8_t*, int32_t, int32_t, int32_t, uint8_t*);
+int32_t drn_fbp_decode(const uint32_t*, int32_t, uint32_t*, int32_t);
+int32_t drn_varint_decode(const uint8_t*, int32_t, uint32_t*, int32_t);
+}
+
+static ffi::Error BloomQueryImpl(ffi::Buffer<ffi::U8> bitmap, int64_t num_hash,
+                                 ffi::ResultBuffer<ffi::U8> mask) {
+  int32_t m_bits = (int32_t)bitmap.element_count() * 8;
+  int32_t d = (int32_t)mask->element_count();
+  drn_bloom_query_universe(bitmap.typed_data(), m_bits, (int32_t)num_hash, d,
+                           mask->typed_data());
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    DrnBloomQuery, BloomQueryImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::U8>>()
+        .Attr<int64_t>("num_hash")
+        .Ret<ffi::Buffer<ffi::U8>>());
+
+static ffi::Error FbpDecodeImpl(ffi::Buffer<ffi::U32> words,
+                                ffi::ResultBuffer<ffi::U32> out) {
+  int32_t cap = (int32_t)out->element_count();
+  std::memset(out->typed_data(), 0, cap * 4);
+  int32_t n = drn_fbp_decode(words.typed_data(), (int32_t)words.element_count(),
+                             out->typed_data(), cap);
+  if (n < 0) return ffi::Error(ffi::ErrorCode::kInvalidArgument, "fbp_decode failed");
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    DrnFbpDecode, FbpDecodeImpl,
+    ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::U32>>().Ret<ffi::Buffer<ffi::U32>>());
+
+static ffi::Error VarintDecodeImpl(ffi::Buffer<ffi::U8> bytes,
+                                   ffi::ResultBuffer<ffi::U32> out) {
+  int32_t cap = (int32_t)out->element_count();
+  std::memset(out->typed_data(), 0, cap * 4);
+  drn_varint_decode(bytes.typed_data(), (int32_t)bytes.element_count(),
+                    out->typed_data(), cap);
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    DrnVarintDecode, VarintDecodeImpl,
+    ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::U8>>().Ret<ffi::Buffer<ffi::U32>>());

@@ -1,0 +1,67 @@
+"""XLA FFI custom-call bindings for the native codec kernels.
+
+The reference registers its C++ kernels as TensorFlow custom ops
+(bloom_filter_compression.cc:19-36, loaded at
+tensorflow/deepreduce.py:328-330). The XLA-native equivalent: the same
+kernels compiled against jaxlib's bundled XLA FFI headers
+(`native/xla_ffi_ops.cc`), registered as CPU-platform custom-call targets —
+they appear *inside* jitted programs instead of going through
+`pure_callback`'s host round trip.
+
+Available as `jax.ffi.ffi_call` wrappers after `register()` (idempotent;
+CPU platform — the axon TPU PJRT executes no host custom-calls, like it
+executes no callbacks)."""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_DIR = pathlib.Path(__file__).parent
+_LIB = _DIR / "libdeepreduce_xla_ffi.so"
+_registered = False
+
+
+def build() -> None:
+    subprocess.run(["make", "-s", "-C", str(_DIR), "xla"], check=True)
+
+
+def register() -> None:
+    """Build (if needed) and register the FFI targets. Idempotent."""
+    global _registered
+    if _registered:
+        return
+    if not _LIB.exists():
+        build()
+    lib = ctypes.CDLL(str(_LIB))
+    for name, sym in [
+        ("drn_bloom_query", "DrnBloomQuery"),
+        ("drn_fbp_decode", "DrnFbpDecode"),
+        ("drn_varint_decode", "DrnVarintDecode"),
+    ]:
+        jax.ffi.register_ffi_target(name, jax.ffi.pycapsule(getattr(lib, sym)), platform="cpu")
+    _registered = True
+
+
+def bloom_query(bitmap_bytes: jax.Array, num_hash: int, d: int) -> jax.Array:
+    """uint8[m_bytes] -> uint8[d] membership mask, as an XLA custom call."""
+    register()
+    return jax.ffi.ffi_call("drn_bloom_query", jax.ShapeDtypeStruct((d,), jnp.uint8))(
+        bitmap_bytes, num_hash=np.int64(num_hash)
+    )
+
+
+def fbp_decode(words: jax.Array, n: int) -> jax.Array:
+    """uint32 FBP stream -> uint32[n] delta-decoded values."""
+    register()
+    return jax.ffi.ffi_call("drn_fbp_decode", jax.ShapeDtypeStruct((n,), jnp.uint32))(words)
+
+
+def varint_decode(data: jax.Array, n: int) -> jax.Array:
+    register()
+    return jax.ffi.ffi_call("drn_varint_decode", jax.ShapeDtypeStruct((n,), jnp.uint32))(data)

@@ -1,0 +1,40 @@
+"""XLA FFI custom-call layer: native kernels inside jitted programs."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu import native
+from deepreduce_tpu.codecs import bloom
+from deepreduce_tpu.native import xla_ops
+
+
+def test_fbp_decode_custom_call_round_trip():
+    idx = np.sort(np.random.default_rng(0).choice(50000, 300, replace=False)).astype(np.uint32)
+    enc = native.fbp_encode(idx)
+    out = jax.jit(lambda w: xla_ops.fbp_decode(w, 300))(jnp.asarray(enc))
+    np.testing.assert_array_equal(np.asarray(out), idx)
+
+
+def test_varint_decode_custom_call_round_trip():
+    idx = np.sort(np.random.default_rng(1).choice(1 << 20, 200, replace=False)).astype(np.uint32)
+    enc = native.varint_encode(idx)
+    out = jax.jit(lambda b: xla_ops.varint_decode(b, 200))(jnp.asarray(enc))
+    np.testing.assert_array_equal(np.asarray(out), idx)
+
+
+def test_bloom_query_custom_call_matches_ctypes_and_jax():
+    rng = np.random.default_rng(2)
+    d, k = 30000, 128
+    idx = np.sort(rng.choice(d, k, replace=False)).astype(np.int32)
+    meta = bloom.BloomMeta.create(k, d, fpr=0.01)
+    bitmap = native.bloom_insert(idx, meta.m_bits, meta.num_hash)
+    ffi_mask = jax.jit(lambda b: xla_ops.bloom_query(b, meta.num_hash, d))(jnp.asarray(bitmap))
+    ref_mask = native.bloom_query_universe(bitmap, meta.num_hash, d)
+    np.testing.assert_array_equal(np.asarray(ffi_mask), ref_mask)
+    # and equal to the pure-JAX codec (shared hash mix)
+    words = bloom.insert(jnp.asarray(idx), jnp.asarray(k), meta)
+    jax_mask = np.asarray(bloom.query_universe(words, meta)).astype(np.uint8)
+    np.testing.assert_array_equal(np.asarray(ffi_mask), jax_mask)

@@ -29,6 +29,7 @@ class QSGDMeta:
     k: int
     quantum_num: int = 127
     bucket_size: int = 512
+    use_pallas: bool = False  # hardware-PRNG stochastic rounding (TPU only)
 
     @property
     def num_buckets(self) -> int:
@@ -48,16 +49,15 @@ class QSGDPayload:
 
 
 def encode(sp: SparseGrad, meta: QSGDMeta, key: jax.Array) -> QSGDPayload:
+    from deepreduce_tpu.ops import quantize_levels
+
     b, bs, q = meta.num_buckets, meta.bucket_size, meta.quantum_num
     padded = jnp.zeros((b * bs,), jnp.float32).at[: meta.k].set(sp.values)
     buckets = padded.reshape(b, bs)
     norms = jnp.linalg.norm(buckets, axis=1)
     safe = jnp.where(norms > 0, norms, 1.0)
-    level_float = q / safe[:, None] * jnp.abs(buckets)
-    lo = jnp.floor(level_float)
-    prob = jax.random.uniform(key, buckets.shape)
-    level = lo + (prob < (level_float - lo)).astype(jnp.float32)
-    levels_i8 = (level * jnp.sign(buckets)).astype(jnp.int8)
+    scale = jnp.broadcast_to((q / safe)[:, None], buckets.shape).reshape(-1)
+    levels_i8 = quantize_levels(padded, scale, key, use_pallas=meta.use_pallas).reshape(b, bs)
     norm_bytes = jax.lax.bitcast_convert_type(norms, jnp.uint8).astype(jnp.int8)  # [B, 4]
     data = jnp.concatenate([levels_i8, norm_bytes], axis=1).reshape(-1)
     return QSGDPayload(data=data, indices=sp.indices, nnz=sp.nnz)

@@ -272,6 +272,7 @@ class QSGDCodec(Codec):
             k=k,
             quantum_num=int(self.params.get("quantum_num", 127)),
             bucket_size=int(self.params.get("bucket_size", 512)),
+            use_pallas=bool(self.params.get("use_pallas", False)),
         )
 
     def encode(self, sp, dense=None, *, step=0, key=None):

@@ -41,6 +41,7 @@ class DeepReduceConfig:
     bucket_size: int = 512
     sort: bool = False
     seed: int = 0
+    use_pallas: bool = False  # pallas TPU kernels where applicable (QSGD PRNG)
     # small-tensor bypass (pytorch/deepreduce.py:68)
     min_compress_size: int = 1000
     # observability
@@ -56,6 +57,7 @@ class DeepReduceConfig:
             "bucket_size": self.bucket_size,
             "sort": self.sort,
             "seed": self.seed,
+            "use_pallas": self.use_pallas,
         }
 
 

@@ -1,0 +1,16 @@
+"""Pallas TPU kernels for codec hot ops.
+
+Engineering note on scope (SURVEY.md §7 hard part 4): the codec pipeline's
+dominant ops — universe-sized filter queries, top-k selection, bit-scatter —
+are *per-lane dynamic-indexing* ops. Mosaic/Pallas on TPU exposes only
+contiguous dynamic slices (`pl.ds`), no per-lane VMEM gather, so those stay
+on XLA's gather/top_k paths (which are latency-bound on the same hardware
+either way); the blocked-bloom redesign (`codecs.bloom`) attacks them
+algorithmically instead (h gathers -> 1). Pallas is used where it genuinely
+beats XLA: stochastic quantization, whose XLA formulation must materialize
+threefry random bits while `pltpu.prng_random_bits` is nearly free.
+"""
+
+from deepreduce_tpu.ops.qsgd_kernel import quantize_levels, quantize_levels_pallas
+
+__all__ = ["quantize_levels", "quantize_levels_pallas"]

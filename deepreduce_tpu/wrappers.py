@@ -41,6 +41,14 @@ from deepreduce_tpu.metrics import WireStats
 from deepreduce_tpu.sparse import SparseGrad
 
 
+def _timed(fn) -> float:
+    import time
+
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class BothPayload:
@@ -179,6 +187,46 @@ class TensorCodec:
         return out.to_dense()
 
     # ------------------------------------------------------------------ #
+
+    def micro_benchmark(self, tensor: jax.Array, *, iters: int = 5) -> dict:
+        """The reference's ``'micro-benchmark': True`` mode
+        (pytorch/deepreduce.py:70-76,255-257): per-stage wall times and
+        relative volumes, measured host-side around jitted encode/decode.
+        Synchronization reads a scalar back (axon's block_until_ready is a
+        no-op)."""
+        import time
+
+        import numpy as np
+
+        key = jax.random.PRNGKey(self.cfg.seed)
+        enc = jax.jit(lambda t, s: self.encode(t, step=s, key=key))
+        dec = jax.jit(lambda p, s: self.decode(p, step=s))
+
+        def sync(x):
+            for leaf in jax.tree_util.tree_leaves(x):
+                if getattr(leaf, "size", 0):
+                    np.asarray(leaf.reshape(-1)[0])
+                    return x
+            return x
+
+        payload = sync(enc(tensor, 0))
+        sync(dec(payload, 0))
+        t_enc = min(
+            _timed(lambda: sync(enc(tensor, 1))) for _ in range(iters)
+        )
+        t_dec = min(_timed(lambda: sync(dec(payload, 1))) for _ in range(iters))
+        stats = self.wire_stats(payload)
+        out = {
+            "compression_time": t_enc,
+            "decompression_time": t_dec,
+            "idx_relative_volume": float(stats.idx_rel_volume()),
+            "val_relative_volume": float(stats.val_rel_volume()),
+            "relative_volume": float(stats.rel_volume()),
+        }
+        if self.cfg.micro_benchmark:
+            for k, v in out.items():
+                print(f"{k}:{v}")
+        return out
 
     def wire_stats(self, payload: Any) -> WireStats:
         dense_bits = jnp.asarray(self.d * 32, jnp.float32)

@@ -1,16 +1,60 @@
 """BERT-base encoder — BASELINE.json config 5: a *new* stress test of the
 allgather path at 110M params (the reference has no attention models;
 SURVEY.md §5 'long-context: absent'). Written MXU-first: fused QKV matmul,
-bf16-friendly, static seq length."""
+bf16-friendly, static seq length.
+
+Long-context modes: ``attention='ring'`` / ``'ulysses'`` with
+``seq_axis='seq'`` shard the sequence over a mesh axis — call the model
+inside ``shard_map`` with per-device token chunks; position embeddings are
+offset by the device's global chunk start. For a given non-dense attention
+mode, ``seq_axis=None`` computes the same function locally with an
+identical parameter tree, so sharded and unsharded forwards are directly
+comparable. (``attention='dense'`` uses flax's MHA module and therefore a
+*different* param layout — checkpoints don't transfer across modes.)
+"""
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
+from deepreduce_tpu.parallel.ring import ring_attention
+from deepreduce_tpu.parallel.ulysses import ulysses_attention
+
 Dtype = Any
+
+
+class SeqParallelSelfAttention(nn.Module):
+    """Self-attention whose score/softmax stage runs ring / Ulysses /
+    local-dense over a sequence-sharded mesh axis. QKV and output
+    projections are plain per-token matmuls, so they need no communication
+    under sequence sharding."""
+
+    heads: int
+    qkv_features: int
+    attention: str = "dense"  # dense | ring | ulysses
+    seq_axis: Optional[str] = None
+    causal: bool = False
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # [batch, chunk, hidden]
+        head_dim = self.qkv_features // self.heads
+        proj = lambda name: nn.DenseGeneral(
+            features=(self.heads, head_dim), dtype=self.dtype, name=name
+        )
+        q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
+        axis = self.seq_axis if self.attention != "dense" else None
+        if self.attention == "ulysses":
+            out = ulysses_attention(q, k, v, axis, causal=self.causal)
+        else:
+            out = ring_attention(q, k, v, axis, causal=self.causal)
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), dtype=self.dtype, name="out"
+        )(out)
 
 
 class TransformerLayer(nn.Module):
@@ -18,13 +62,36 @@ class TransformerLayer(nn.Module):
     heads: int
     mlp_dim: int
     dtype: Dtype = jnp.float32
+    attention: str = "dense"
+    seq_axis: Optional[str] = None
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None):
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        attn = nn.MultiHeadDotProductAttention(
-            num_heads=self.heads, qkv_features=self.hidden, dtype=self.dtype
-        )(h, h, mask=mask)
+        if self.attention == "dense":
+            if self.seq_axis is not None:
+                raise ValueError(
+                    "attention='dense' cannot run sequence-sharded; "
+                    "use attention='ring' or 'ulysses' with seq_axis"
+                )
+            attn = nn.MultiHeadDotProductAttention(
+                num_heads=self.heads, qkv_features=self.hidden, dtype=self.dtype
+            )(h, h, mask=mask)
+        else:
+            if mask is not None:
+                raise ValueError(
+                    "ring/ulysses attention supports only the built-in causal "
+                    "mask; arbitrary masks need the dense path"
+                )
+            attn = SeqParallelSelfAttention(
+                heads=self.heads,
+                qkv_features=self.hidden,
+                attention=self.attention,
+                seq_axis=self.seq_axis,
+                causal=self.causal,
+                dtype=self.dtype,
+            )(h)
         x = x + attn
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
@@ -41,17 +108,31 @@ class BertEncoder(nn.Module):
     mlp_dim: int = 3072
     max_len: int = 512
     dtype: Dtype = jnp.float32
+    attention: str = "dense"  # dense | ring | ulysses
+    seq_axis: Optional[str] = None  # sequence-sharded mesh axis (shard_map)
+    causal: bool = False
 
     @nn.compact
-    def __call__(self, tokens):  # [batch, seq] int32 -> MLM logits
+    def __call__(self, tokens):  # [batch, chunk] int32 -> MLM logits
         seq = tokens.shape[1]
+        offset = 0
+        if self.seq_axis is not None and self.attention != "dense":
+            offset = jax.lax.axis_index(self.seq_axis) * seq
         x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype, name="tok")(tokens)
         pos = nn.Embed(self.max_len, self.hidden, dtype=self.dtype, name="pos")(
-            jnp.arange(seq, dtype=jnp.int32)
+            offset + jnp.arange(seq, dtype=jnp.int32)
         )
         x = x + pos[None, :, :]
         x = nn.LayerNorm(dtype=self.dtype)(x)
         for _ in range(self.layers):
-            x = TransformerLayer(self.hidden, self.heads, self.mlp_dim, dtype=self.dtype)(x)
+            x = TransformerLayer(
+                self.hidden,
+                self.heads,
+                self.mlp_dim,
+                dtype=self.dtype,
+                attention=self.attention,
+                seq_axis=self.seq_axis,
+                causal=self.causal,
+            )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="mlm")(x)

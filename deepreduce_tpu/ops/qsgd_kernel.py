@@ -32,19 +32,26 @@ def _kernel(seed_ref, vals_ref, scale_ref, out_ref):
     level_float = jnp.abs(v) * scale
     lo = jnp.floor(level_float)
     bits = pltpu.prng_random_bits(v.shape)
-    u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+    # bits is int32: mask after the shift so sign-extension can't push u
+    # negative — u must be uniform on [0, 1) for unbiased rounding
+    u = ((bits >> 8) & 0xFFFFFF).astype(jnp.float32) * (1.0 / (1 << 24))
     level = lo + (u < (level_float - lo)).astype(jnp.float32)
     out_ref[...] = (level * jnp.sign(v)).astype(jnp.int8)
 
 
 def quantize_levels_pallas(values: jax.Array, scale: jax.Array, seed: jax.Array) -> jax.Array:
     """values f32[n], scale f32[n] (q/norm broadcast per bucket), seed i32[]
-    -> int8[n] signed levels. n must be a multiple of 512."""
+    -> int8[n] signed levels. Any n: inputs are padded to the (32, 512)
+    int8 tile internally."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n = values.shape[0]
-    rows = n // _BLOCK_COLS
+    lane_pad = (-n) % _BLOCK_COLS
+    if lane_pad:
+        values = jnp.concatenate([values, jnp.zeros((lane_pad,), values.dtype)])
+        scale = jnp.concatenate([scale, jnp.ones((lane_pad,), scale.dtype)])
+    rows = (n + lane_pad) // _BLOCK_COLS
     pad_rows = (-rows) % _BLOCK_ROWS
     v2 = jnp.zeros((rows + pad_rows, _BLOCK_COLS), jnp.float32).at[:rows].set(
         values.reshape(rows, _BLOCK_COLS)
@@ -59,14 +66,15 @@ def quantize_levels_pallas(values: jax.Array, scale: jax.Array, seed: jax.Array)
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((_BLOCK_ROWS, _BLOCK_COLS), lambda i: (i, 0)),
-                pl.BlockSpec((_BLOCK_ROWS, _BLOCK_COLS), lambda i: (i, 0)),
+                # index maps get the prefetched scalar ref as a trailing arg
+                pl.BlockSpec((_BLOCK_ROWS, _BLOCK_COLS), lambda i, *_: (i, 0)),
+                pl.BlockSpec((_BLOCK_ROWS, _BLOCK_COLS), lambda i, *_: (i, 0)),
             ],
-            out_specs=pl.BlockSpec((_BLOCK_ROWS, _BLOCK_COLS), lambda i: (i, 0)),
+            out_specs=pl.BlockSpec((_BLOCK_ROWS, _BLOCK_COLS), lambda i, *_: (i, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((rows + pad_rows, _BLOCK_COLS), jnp.int8),
     )(jnp.asarray(seed, jnp.int32).reshape(1), v2, s2)
-    return out[:rows].reshape(n)
+    return out[:rows].reshape(-1)[:n]
 
 
 def quantize_levels_xla(values: jax.Array, scale: jax.Array, key: jax.Array) -> jax.Array:

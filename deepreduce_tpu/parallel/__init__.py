@@ -1,0 +1,33 @@
+"""Parallelism strategies over the device mesh.
+
+The reference is data-parallel only (SURVEY.md §2.5: Horovod DP over
+MPI+NCCL, run_deepreduce.sh:4-9); this package carries the framework past
+it: the DP gradient-exchange communicator lives in `deepreduce_tpu.comm`,
+and long-context sequence/context parallelism + tensor parallelism live
+here, all expressed as XLA collectives (`ppermute`, `all_to_all`, GSPMD
+sharding) over a `jax.sharding.Mesh` — ICI-native, no NCCL/MPI.
+
+- `mesh`      — mesh construction helpers (factor a device count into
+                named axes: data / seq / model).
+- `ring`      — ring attention: blockwise flash-style attention with K/V
+                blocks rotating around the sequence axis via `ppermute`.
+- `ulysses`   — all-to-all sequence parallelism (DeepSpeed-Ulysses style):
+                scatter heads / gather sequence, dense attention, invert.
+- `tp`        — tensor-parallel GSPMD sharding rules (Megatron-style
+                column/row splits expressed as PartitionSpecs; XLA inserts
+                the collectives).
+"""
+
+from deepreduce_tpu.parallel.mesh import factor_devices, make_mesh
+from deepreduce_tpu.parallel.ring import ring_attention
+from deepreduce_tpu.parallel.ulysses import ulysses_attention
+from deepreduce_tpu.parallel.tp import bert_tp_rules, tp_shardings
+
+__all__ = [
+    "factor_devices",
+    "make_mesh",
+    "ring_attention",
+    "ulysses_attention",
+    "bert_tp_rules",
+    "tp_shardings",
+]

@@ -1,0 +1,53 @@
+"""Mesh construction helpers.
+
+The reference pins its world layout in a shell script (`mpirun -np 8 -H
+host:1,...`, run_deepreduce.sh:4-9); here the layout is a
+`jax.sharding.Mesh` with named axes, and every collective in the framework
+names the axis it rides on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def factor_devices(n: int, axes: Sequence[str]) -> Dict[str, int]:
+    """Factor a device count into mesh axis sizes, greedily giving the
+    earlier axes the larger factors (data first, then seq/model).
+
+    8, ('data','seq') -> {'data': 4, 'seq': 2};  7 -> {'data': 7, 'seq': 1}.
+    """
+    sizes = {a: 1 for a in axes}
+    remaining = n
+    names = list(axes)
+    for i, name in enumerate(names[:-1]):
+        # largest factor <= sqrt-balanced split that divides `remaining`,
+        # biased so the leading axis keeps the bulk
+        target = max(1, round(remaining ** (1.0 - 1.0 / (len(names) - i))))
+        best = 1
+        for f in range(1, remaining + 1):
+            if remaining % f == 0 and f <= max(target, 1):
+                best = f
+        # leading axis gets the co-factor (the big one)
+        sizes[name] = remaining // best if i == 0 else best
+        remaining = remaining // sizes[name]
+    sizes[names[-1]] = remaining
+    return sizes
+
+
+def make_mesh(
+    axes: Dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh from {axis_name: size}. Sizes must multiply to the device count
+    used. `make_mesh({'data': 4, 'seq': 2})` on 8 devices."""
+    shape: Tuple[int, ...] = tuple(axes.values())
+    n = int(np.prod(shape))
+    devs = list(devices) if devices is not None else jax.devices()[:n]
+    if len(devs) != n:
+        raise ValueError(f"need {n} devices for mesh {axes}, have {len(devs)}")
+    return Mesh(np.asarray(devs).reshape(shape), tuple(axes.keys()))

@@ -1,0 +1,119 @@
+"""Ring attention — context parallelism for long sequences.
+
+Q/K/V live sequence-sharded over a mesh axis: each device holds one
+contiguous chunk ``[batch, seq/n, heads, head_dim]``. Attention runs in
+``n`` rounds: every round each device computes blockwise attention of its
+resident Q chunk against the K/V block currently in hand (flash-style
+streaming softmax so nothing seq×seq ever materializes), then rotates the
+K/V block one hop around the ring with `jax.lax.ppermute` — compute
+overlaps the ICI transfer and no device ever holds more than one remote
+block. This is the TPU-native long-context answer to a capability the
+CUDA/NCCL reference lacks entirely (SURVEY.md §5: "long-context: absent").
+
+Numerics: scores and the softmax accumulator run in float32 regardless of
+input dtype (bf16 Q/K/V stays bf16 on the MXU matmuls).
+
+Causal mode uses *global* positions (device index × chunk) so the mask is
+exact across the ring. Fully-masked (future) blocks still run — one wasted
+matmul per skippable block; the streaming max starts at a finite floor so
+their contribution is exactly zeroed once any unmasked block lands, and the
+diagonal block lands first (round 0), so every row is anchored from the
+start.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30  # finite floor: keeps exp() well-defined for masked rows
+
+
+def _block_attend(q, k, v, o, m, l, *, scale, causal, q_off, k_off):
+    """One flash-attention block update.
+
+    q [b,cq,h,d], k/v [b,ck,h,d]; accumulators o [b,cq,h,d] f32,
+    m,l [b,h,cq] f32. Returns updated (o, m, l).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        cq, ck = q.shape[1], k.shape[1]
+        qpos = q_off + jnp.arange(cq)
+        kpos = k_off + jnp.arange(ck)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * jnp.moveaxis(alpha, 1, 2)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str],
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Multi-head attention over a sequence sharded on ``axis_name``.
+
+    Call inside ``shard_map``; q/k/v are the per-device chunks
+    ``[batch, chunk, heads, head_dim]``. With ``axis_name=None`` it
+    degrades to plain (local, unsharded) flash attention — the oracle the
+    tests compare against.
+    """
+    b, cq, h, d = q.shape
+    scale = (1.0 / d**0.5) if scale is None else scale
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((b, h, cq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, cq), jnp.float32)
+
+    if axis_name is None:
+        o, m, l = _block_attend(
+            q, k, v, o, m, l, scale=scale, causal=causal, q_off=0, k_off=0
+        )
+        return (o / l[..., None].swapaxes(1, 2)).astype(q.dtype)
+
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    ck = k.shape[1]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    # fresh accumulators are device-invariant; mark them varying over the
+    # ring axis so the fori_loop carry type is stable round-to-round
+    o, m, l = jax.lax.pcast((o, m, l), (axis_name,), to="varying")
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (me - i) % n  # which global block is resident this round
+        o, m, l = _block_attend(
+            q, k_blk, v_blk, o, m, l,
+            scale=scale, causal=causal, q_off=me * cq, k_off=src * ck,
+        )
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_blk, v_blk
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    return (o / l[..., None].swapaxes(1, 2)).astype(q.dtype)
+
+
+def ring_self_attention_reference(q, k, v, *, causal=False, scale=None):
+    """Unsharded O(s²) oracle for tests: plain softmax attention."""
+    d = q.shape[-1]
+    scale = (1.0 / d**0.5) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

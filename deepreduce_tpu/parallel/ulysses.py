@@ -1,0 +1,45 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The dual of ring attention: instead of rotating K/V around the sequence
+axis, one `jax.lax.all_to_all` re-shards the activations from
+sequence-sharded ``[b, s/n, h, d]`` to head-sharded ``[b, s, h/n, d]``,
+dense attention runs locally over the *full* sequence for the local head
+group (big MXU-friendly matmuls, exact softmax, no ring bookkeeping), and a
+second all-to-all inverts the layout. Two collectives per attention call
+vs ring's n ppermutes; requires heads % axis_size == 0.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu.parallel.ring import ring_self_attention_reference
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str],
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name`` (inside
+    shard_map). Per-device chunks ``[batch, chunk, heads, head_dim]``;
+    heads must divide evenly by the axis size. ``axis_name=None`` = local
+    dense attention."""
+    if axis_name is None:
+        return ring_self_attention_reference(q, k, v, causal=causal, scale=scale)
+
+    a2a = lambda x, split, concat: jax.lax.all_to_all(
+        x, axis_name, split_axis=split, concat_axis=concat, tiled=True
+    )
+    # seq-sharded -> head-sharded: split heads(2), gather seq(1)
+    qh, kh, vh = (a2a(t, 2, 1) for t in (q, k, v))
+    out = ring_self_attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    # head-sharded -> seq-sharded: split seq(1), gather heads(2)
+    return a2a(out, 1, 2)

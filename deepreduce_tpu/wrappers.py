@@ -194,8 +194,6 @@ class TensorCodec:
         relative volumes, measured host-side around jitted encode/decode.
         Synchronization reads a scalar back (axon's block_until_ready is a
         no-op)."""
-        import time
-
         import numpy as np
 
         key = jax.random.PRNGKey(self.cfg.seed)

@@ -20,8 +20,9 @@ fixed-budget payloads with in-band length words so XLA collectives get static
 shapes.
 """
 
-from deepreduce_tpu import codecs, comm, config, memory, metrics, sparse
+from deepreduce_tpu import codecs, comm, config, memory, metrics, parallel, sparse
 from deepreduce_tpu.config import DeepReduceConfig, from_params
+from deepreduce_tpu.fedavg import FedAvg, FedAvgState, FedConfig
 from deepreduce_tpu.sparse import SparseGrad
 
 __version__ = "0.1.0"
@@ -30,10 +31,14 @@ __all__ = [
     "SparseGrad",
     "DeepReduceConfig",
     "from_params",
+    "FedAvg",
+    "FedAvgState",
+    "FedConfig",
     "codecs",
     "comm",
     "config",
     "memory",
     "metrics",
+    "parallel",
     "sparse",
 ]

@@ -230,7 +230,8 @@ class TensorCodec:
         dense_bits = jnp.asarray(self.d * 32, jnp.float32)
         if not self.compressed:
             nnz = payload.nnz.astype(jnp.float32)
-            idx_bits = nnz * 32
+            # a dense transmission (no sparsifier) carries no index stream
+            idx_bits = jnp.zeros(()) if self.cfg.compressor == "none" else nnz * 32
             val_bits = nnz * 32
         elif self.cfg.deepreduce == "value":
             idx_bits = self.val_codec.index_wire_bits(payload)

@@ -1,0 +1,28 @@
+"""Cross-cutting utilities: bit-packing, wire accounting, debug dumps.
+
+Stable aliases for the subsystems that the reference keeps in
+compression_utils.hpp / logger.cc / GRACE's `tensor_bits` (SURVEY.md §5):
+
+- `packing`       — jit-compatible bit-packing (codecs/packing.py; the
+                    reference's CuPy packbits + 3x21-bit int64 packers,
+                    pytorch/deepreduce.py:165-248)
+- `metrics`       — `WireStats` bits-on-wire accounting (`tensor_bits` role)
+- `logging_utils` — fpr/policy-error/stats/values file dumps
+                    (compression_utils.hpp:96-176 + Logger op roles)
+"""
+
+from deepreduce_tpu import logging_utils, metrics
+from deepreduce_tpu.codecs import packing
+from deepreduce_tpu.logging_utils import DumpLogger, policy_errors
+from deepreduce_tpu.metrics import WireStats, combine, payload_device_bytes
+
+__all__ = [
+    "packing",
+    "metrics",
+    "logging_utils",
+    "DumpLogger",
+    "policy_errors",
+    "WireStats",
+    "combine",
+    "payload_device_bytes",
+]

@@ -1,0 +1,24 @@
+"""The driver's own entry points must stay green: single-chip compile
+check of the flagship forward, and the full multichip dry run (compressed
+DP + the dp x sp ring-attention composition) on the virtual mesh."""
+
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+
+
+def test_entry_compiles_single_chip():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn).lower(*args).compile()
+    assert out is not None

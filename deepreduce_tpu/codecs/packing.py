@@ -48,30 +48,41 @@ def budget_words(n: int, max_width: int = 32) -> int:
     return (n * max_width + 31) // 32
 
 
+def _width_mask(width: jax.Array) -> jax.Array:
+    w = jnp.asarray(width, jnp.int32)
+    return jnp.where(
+        w >= 32,
+        jnp.uint32(0xFFFFFFFF),
+        (jnp.uint32(1) << jnp.minimum(w, 31).astype(jnp.uint32)) - jnp.uint32(1),
+    )
+
+
 def pack(
     values: jax.Array, width: jax.Array, *, max_width: int = 32, n_budget_words: int | None = None
 ) -> PackedInts:
     """Pack uint values at `width` bits each (dynamic) into uint32 words
-    (static budget). Values must fit in `width` bits; higher bits dropped."""
+    (static budget). Values must fit in `width` bits; higher bits dropped.
+
+    Value `i` spans stream bits [i*width, (i+1)*width), which straddle at
+    most two uint32 words — so each value contributes exactly two
+    scatter-adds (the high one zero when it ends in-word). Bit ranges are
+    disjoint across values, so scatter-add == bitwise OR."""
     values = values.astype(jnp.uint32)
     n = values.shape[0]
     nw = budget_words(n, max_width) if n_budget_words is None else n_budget_words
     width = jnp.asarray(width, jnp.int32)
+    v = values & _width_mask(width)
 
-    b = jnp.arange(max_width, dtype=jnp.int32)  # candidate bit lanes
-    # bit (i, b) of the stream
-    bits = (values[:, None] >> b[None, :].astype(jnp.uint32)) & jnp.uint32(1)
-    live = b[None, :] < width
-    pos = jnp.arange(n, dtype=jnp.int32)[:, None] * width + b[None, :]
-    pos = jnp.where(live, pos, nw * 32)  # dead lanes dropped by scatter mode
-    word_idx = pos // 32
-    bit_idx = (pos % 32).astype(jnp.uint32)
-    contrib = jnp.where(live, bits.astype(jnp.uint32) << bit_idx, jnp.uint32(0))
-    # every live (word, bit) pair is unique, so scatter-add == bitwise OR
+    p0 = jnp.arange(n, dtype=jnp.int32) * width
+    w0 = p0 >> 5
+    off = (p0 & 31).astype(jnp.uint32)
+    lo = v << off  # the (32-off) low bits land in word w0; overflow drops
+    sh = jnp.where(off == 0, jnp.uint32(1), jnp.uint32(32) - off)
+    hi = jnp.where(off == 0, jnp.uint32(0), v >> sh)  # spillover into w0+1
     words = (
         jnp.zeros((nw,), jnp.uint32)
-        .at[word_idx.reshape(-1)]
-        .add(contrib.reshape(-1), mode="drop")
+        .at[jnp.concatenate([w0, w0 + 1])]
+        .add(jnp.concatenate([lo, hi]), mode="drop")
     )
     return PackedInts(words=words, count=jnp.asarray(n, jnp.int32), width=width)
 
@@ -79,14 +90,14 @@ def pack(
 def unpack(packed: PackedInts, n: int, *, max_width: int = 32) -> jax.Array:
     """Inverse of `pack`; `n` is the static value count (== packing budget)."""
     width = packed.width
-    b = jnp.arange(max_width, dtype=jnp.int32)
-    pos = jnp.arange(n, dtype=jnp.int32)[:, None] * width + b[None, :]
-    word = packed.words[jnp.clip(pos // 32, 0, packed.words.shape[0] - 1)]
-    bit = (word >> (pos % 32).astype(jnp.uint32)) & jnp.uint32(1)
-    live = b[None, :] < width
-    vals = jnp.sum(
-        jnp.where(live, bit << b[None, :].astype(jnp.uint32), jnp.uint32(0)), axis=1
-    ).astype(jnp.uint32)
+    last = packed.words.shape[0] - 1
+    p0 = jnp.arange(n, dtype=jnp.int32) * width
+    w0 = jnp.clip(p0 >> 5, 0, last)
+    off = (p0 & 31).astype(jnp.uint32)
+    lo = packed.words[w0] >> off
+    sh = jnp.where(off == 0, jnp.uint32(1), jnp.uint32(32) - off)
+    hi = jnp.where(off == 0, jnp.uint32(0), packed.words[jnp.clip(w0 + 1, 0, last)] << sh)
+    vals = (lo | hi) & _width_mask(width)
     live_vals = jnp.arange(n, dtype=jnp.int32) < packed.count
     return jnp.where(live_vals, vals, 0)
 

@@ -2,12 +2,13 @@
 check of the flagship forward, and the full multichip dry run (compressed
 DP + the dp x sp ring-attention composition) on the virtual mesh."""
 
+import os
 import sys
 
 import jax
 import pytest
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def test_dryrun_multichip_8():

@@ -174,18 +174,39 @@ def run(args) -> dict:
         init_batch = batch
     state = trainer.init_state(jax.random.PRNGKey(args.seed), init_batch)
 
+    tracker = None
+    if args.track_dir:
+        from deepreduce_tpu import tracking
+
+        tracker = tracking.Run(
+            args.track_dir,
+            name=args.run_name or None,
+            config={"model": args.model, "workers": n_dev, **params},
+            tags=[t for t in args.tags.split(",") if t],
+        )
+
     key = jax.random.PRNGKey(args.seed + 1)
     losses = []
     t0 = time.perf_counter()
-    for step in range(args.num_steps):
-        batch = make_batch(kind, spec, classes, args.batch_size, rng, model=model)
-        state, loss, wire = trainer.step(state, batch, jax.random.fold_in(key, step))
-        losses.append(float(loss))
-        if args.log_every and step % args.log_every == 0:
-            print(
-                f"step {step} loss {losses[-1]:.4f} "
-                f"rel_volume {float(wire.rel_volume()):.4f}"
-            )
+    try:
+        for step in range(args.num_steps):
+            batch = make_batch(kind, spec, classes, args.batch_size, rng, model=model)
+            state, loss, wire = trainer.step(state, batch, jax.random.fold_in(key, step))
+            losses.append(float(loss))
+            if tracker is not None:
+                tracker.log(
+                    {"loss": losses[-1], "rel_volume": float(wire.rel_volume())},
+                    step=step,
+                )
+            if args.log_every and step % args.log_every == 0:
+                print(
+                    f"step {step} loss {losses[-1]:.4f} "
+                    f"rel_volume {float(wire.rel_volume()):.4f}"
+                )
+    except BaseException:
+        if tracker is not None:
+            tracker.finish({"status": "failed", "steps_completed": len(losses)})
+        raise
     elapsed = time.perf_counter() - t0
 
     result = {
@@ -203,6 +224,8 @@ def run(args) -> dict:
         "config": params,
     }
     print(json.dumps(result))
+    if tracker is not None:
+        tracker.finish(result)
     return result
 
 
@@ -216,6 +239,11 @@ def main():
     ap.add_argument("--learning_rate", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--log_every", type=int, default=5)
+    ap.add_argument("--track_dir", type=str, default="",
+                    help="experiment-tracking root (the reference's WANDB role)")
+    ap.add_argument("--run_name", type=str, default="")
+    ap.add_argument("--tags", type=str, default="",
+                    help="comma-separated run tags (--extra_wandb_tags role)")
     run(ap.parse_args())
 
 

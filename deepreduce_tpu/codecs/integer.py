@@ -57,7 +57,7 @@ def encode(sp: SparseGrad, meta: IntegerMeta) -> IntegerPayload:
 
 
 def decode(payload: IntegerPayload, meta: IntegerMeta, shape: Tuple[int, ...]) -> SparseGrad:
-    deltas = packing.unpack(payload.deltas, meta.k, max_width=meta.max_width).astype(jnp.int32)
+    deltas = packing.unpack(payload.deltas, meta.k).astype(jnp.int32)
     idx = jnp.cumsum(deltas)
     live = jnp.arange(meta.k, dtype=jnp.int32) < payload.nnz
     return SparseGrad(

@@ -87,7 +87,7 @@ def pack(
     return PackedInts(words=words, count=jnp.asarray(n, jnp.int32), width=width)
 
 
-def unpack(packed: PackedInts, n: int, *, max_width: int = 32) -> jax.Array:
+def unpack(packed: PackedInts, n: int) -> jax.Array:
     """Inverse of `pack`; `n` is the static value count (== packing budget)."""
     width = packed.width
     last = packed.words.shape[0] - 1

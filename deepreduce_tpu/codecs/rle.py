@@ -93,7 +93,7 @@ def encode(sp: SparseGrad, meta: RLEMeta) -> RLEPayload:
 
 def decode(payload: RLEPayload, meta: RLEMeta, shape: Tuple[int, ...]) -> SparseGrad:
     k = meta.k
-    arr = packing.unpack(payload.runs, meta.run_budget, max_width=meta.max_width).astype(jnp.int32)
+    arr = packing.unpack(payload.runs, meta.run_budget).astype(jnp.int32)
     n_runs = (payload.runs.count - 1) // 2
     zeros_len = arr[0 : 2 * k : 2][:k]
     ones_len = arr[1 : 2 * k + 1 : 2][:k]

@@ -1,0 +1,112 @@
+"""Local experiment tracking — the reference's WANDB role.
+
+The reference records every run in a public WANDB project as its regression
+record (README.md:53; `--extra_wandb_tags`, run_deepreduce.sh:50,66). This
+environment has no egress, so the same capability is file-based: each run
+gets a directory under the tracking root holding
+
+    config.json    — the run's full config dict + tags, written at start
+    metrics.jsonl  — one JSON object per `log()` call (step-keyed)
+    summary.json   — final metrics written by `finish()`
+
+and `runs()` / `history()` give the offline query side (the role of the
+WANDB dashboard when comparing configs across runs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+def _jsonable(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if hasattr(obj, "item"):  # numpy / jax scalars
+        return obj.item()
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return str(obj)
+
+
+class Run:
+    """One tracked experiment run (the wandb.init(...) object role)."""
+
+    def __init__(
+        self,
+        root: str,
+        name: Optional[str] = None,
+        config: Optional[Dict[str, Any]] = None,
+        tags: Optional[List[str]] = None,
+    ):
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        self.name = name or f"run-{stamp}-{os.getpid()}"
+        self.dir = pathlib.Path(root) / self.name
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._metrics = open(self.dir / "metrics.jsonl", "a")
+        self._step = 0
+        with open(self.dir / "config.json", "w") as f:
+            json.dump(
+                {"name": self.name, "tags": list(tags or []), "config": _jsonable(config or {})},
+                f,
+                indent=2,
+            )
+
+    def log(self, metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+        if step is None:
+            step = self._step
+        self._step = step + 1
+        rec = {"step": int(step), "ts": time.time()}
+        rec.update(_jsonable(metrics))
+        self._metrics.write(json.dumps(rec) + "\n")
+        self._metrics.flush()
+
+    def finish(self, summary: Optional[Dict[str, Any]] = None) -> None:
+        with open(self.dir / "summary.json", "w") as f:
+            json.dump(_jsonable(summary or {}), f, indent=2)
+        self._metrics.close()
+
+    def __enter__(self) -> "Run":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not self._metrics.closed:
+            self.finish()
+
+
+def runs(root: str) -> List[str]:
+    """Run names under a tracking root, oldest first (dashboard listing)."""
+    p = pathlib.Path(root)
+    if not p.is_dir():
+        return []
+    return sorted(d.name for d in p.iterdir() if (d / "config.json").exists())
+
+
+def config(root: str, name: str) -> Dict[str, Any]:
+    with open(pathlib.Path(root) / name / "config.json") as f:
+        return json.load(f)
+
+
+def history(root: str, name: str) -> Iterator[Dict[str, Any]]:
+    """Step-keyed metric records of one run (wandb run.history role)."""
+    path = pathlib.Path(root) / name / "metrics.jsonl"
+    if not path.exists():
+        return
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+
+def summary(root: str, name: str) -> Dict[str, Any]:
+    path = pathlib.Path(root) / name / "summary.json"
+    if not path.exists():
+        return {}
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,54 @@
+"""Experiment tracker (the reference's WANDB regression-record role,
+README.md:53): run dirs with config.json / metrics.jsonl / summary.json,
+plus the offline query side."""
+
+import json
+
+from deepreduce_tpu import tracking
+
+
+def test_run_records_config_metrics_summary(tmp_path):
+    root = str(tmp_path / "track")
+    with tracking.Run(root, name="exp1", config={"fpr": 0.001, "index": "bloom"},
+                      tags=["bloom", "p0"]) as run:
+        run.log({"loss": 1.5, "rel_volume": 0.12}, step=0)
+        run.log({"loss": 0.9, "rel_volume": 0.12}, step=5)
+        run.finish({"last_loss": 0.9})
+
+    assert tracking.runs(root) == ["exp1"]
+    cfg = tracking.config(root, "exp1")
+    assert cfg["config"]["fpr"] == 0.001
+    assert cfg["tags"] == ["bloom", "p0"]
+
+    hist = list(tracking.history(root, "exp1"))
+    assert [h["step"] for h in hist] == [0, 5]
+    assert hist[1]["loss"] == 0.9
+    assert tracking.summary(root, "exp1")["last_loss"] == 0.9
+
+
+def test_numpy_scalars_jsonable(tmp_path):
+    import numpy as np
+
+    root = str(tmp_path / "track")
+    run = tracking.Run(root, name="exp2", config={"ratio": np.float32(0.01)})
+    run.log({"loss": np.float64(2.0), "k": np.int32(7)})
+    run.finish({"arr": [np.int64(1), np.int64(2)]})
+    hist = list(tracking.history(root, "exp2"))
+    assert hist[0]["loss"] == 2.0 and hist[0]["k"] == 7
+    assert tracking.summary(root, "exp2")["arr"] == [1, 2]
+    # everything on disk is plain JSON
+    for f in ("config.json", "summary.json"):
+        json.load(open(f"{root}/exp2/{f}"))
+
+
+def test_auto_step_and_missing_run(tmp_path):
+    root = str(tmp_path / "t")
+    run = tracking.Run(root)
+    run.log({"a": 1})
+    run.log({"a": 2})
+    run.finish()
+    name = tracking.runs(root)[0]
+    assert [h["step"] for h in tracking.history(root, name)] == [0, 1]
+    assert tracking.runs(str(tmp_path / "nope")) == []
+    assert tracking.summary(root, name) == {}  # wrong-name guard below
+    assert tracking.summary(root, "missing") == {}

@@ -1,0 +1,155 @@
+"""Convergence-parity experiment — the reference's Table-1 methodology
+(SURVEY.md §4.1: train with and without compression, compare final accuracy;
+"pass" = compressed accuracy within noise of dense at a fraction of the data
+volume).
+
+No dataset egress in this environment, so the task is a *learnable* synthetic
+classification problem (fixed random teacher network labels deterministic
+inputs) rather than CIFAR — the comparison dense-vs-compressed is what the
+experiment measures, and both arms see identical data. Runs on the 8-device
+virtual CPU mesh or real TPU.
+
+    python benchmarks/convergence.py --steps 150 \
+      --grace_config "{'compressor':'topk','compress_ratio':0.05,
+                       'memory':'residual','deepreduce':'both',
+                       'index':'bloom','value':'qsgd','fpr':0.01}"
+
+Prints one JSON line: dense vs compressed final accuracy, gap, and the
+compressed arm's relative wire volume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+
+def make_task(n, dim, classes, seed):
+    """Deterministic teacher-labelled dataset: learnable, identical for
+    both arms."""
+    rng = np.random.default_rng(seed)
+    w1 = rng.normal(size=(dim, 64)) / np.sqrt(dim)
+    w2 = rng.normal(size=(64, classes)) / 8.0
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = np.argmax(np.tanh(x @ w1) @ w2, axis=1).astype(np.int32)
+    return x, y
+
+
+def accuracy(model, params, batch_stats, x, y, batch=256):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def logits_fn(xb):
+        variables = {"params": params}
+        if batch_stats is not None:
+            variables["batch_stats"] = batch_stats
+        return model.apply(variables, xb)
+
+    correct = 0
+    for lo in range(0, len(x), batch):
+        out = logits_fn(jnp.asarray(x[lo : lo + batch]))
+        correct += int((np.argmax(np.asarray(out), axis=1) == y[lo : lo + batch]).sum())
+    return correct / len(x)
+
+
+def train_arm(cfg, x, y, steps, batch, lr, seed):
+    import jax
+    import optax
+    from jax.sharding import Mesh
+
+    import flax.linen as nn
+
+    from deepreduce_tpu.train import Trainer
+
+    class MLP(nn.Module):
+        classes: int
+
+        @nn.compact
+        def __call__(self, xb):
+            xb = nn.relu(nn.Dense(128)(xb))
+            xb = nn.relu(nn.Dense(128)(xb))
+            return nn.Dense(self.classes)(xb)
+
+    classes = int(y.max()) + 1
+    model = MLP(classes=classes)
+    n_dev = min(8, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
+    trainer = Trainer(model, cfg, optax.sgd(lr, momentum=0.9), mesh)
+    state = trainer.init_state(jax.random.PRNGKey(seed), (x[:batch], y[:batch]))
+
+    key = jax.random.PRNGKey(seed + 1)
+    order = np.random.default_rng(seed + 2).permutation(len(x))
+    wire = None
+    for step in range(steps):
+        sel = order[(np.arange(batch) + step * batch) % len(x)]  # full batch, wraps
+        state, loss, wire = trainer.step(
+            state, (x[sel], y[sel]), jax.random.fold_in(key, step)
+        )
+    acc = accuracy(model, state.params, state.batch_stats, x, y)
+    return acc, float(wire.rel_volume())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grace_config", type=str, default=(
+        "{'compressor':'topk','compress_ratio':0.05,'memory':'residual',"
+        "'deepreduce':'both','index':'bloom','value':'qsgd','fpr':0.01,"
+        "'min_compress_size':500}"))
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--batch_size", type=int, default=128)
+    ap.add_argument("--learning_rate", type=float, default=0.1)
+    ap.add_argument("--n_examples", type=int, default=4096)
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--platform", type=str, default="",
+                    help="'cpu' forces the 8-device virtual CPU mesh (env vars "
+                         "alone don't stick under the axon TPU tunnel)")
+    args = ap.parse_args()
+
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
+    if args.n_examples < 2 * args.batch_size:
+        ap.error("--n_examples must be at least 2x --batch_size")
+
+    if args.platform:
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform(args.platform)
+
+    from deepreduce_tpu.config import DeepReduceConfig, from_params
+
+    x, y = make_task(args.n_examples, args.dim, args.classes, args.seed)
+
+    dense_cfg = DeepReduceConfig(
+        compressor="none", deepreduce=None, memory="none", communicator="allreduce"
+    )
+    comp_cfg = from_params(ast.literal_eval(args.grace_config))
+
+    dense_acc, _ = train_arm(
+        dense_cfg, x, y, args.steps, args.batch_size, args.learning_rate, args.seed
+    )
+    comp_acc, rel_volume = train_arm(
+        comp_cfg, x, y, args.steps, args.batch_size, args.learning_rate, args.seed
+    )
+
+    print(json.dumps({
+        "dense_acc": round(dense_acc, 4),
+        "compressed_acc": round(comp_acc, 4),
+        "acc_gap": round(dense_acc - comp_acc, 4),
+        "rel_volume": round(rel_volume, 4),
+        "steps": args.steps,
+        "config": ast.literal_eval(args.grace_config),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -187,9 +187,13 @@ def run(args) -> dict:
 
     key = jax.random.PRNGKey(args.seed + 1)
     losses = []
+    profiling = False
     t0 = time.perf_counter()
     try:
         for step in range(args.num_steps):
+            if args.profile_dir and step == min(2, args.num_steps - 1) and not profiling:
+                jax.profiler.start_trace(args.profile_dir)  # skip compile steps
+                profiling = True
             batch = make_batch(kind, spec, classes, args.batch_size, rng, model=model)
             state, loss, wire = trainer.step(state, batch, jax.random.fold_in(key, step))
             losses.append(float(loss))
@@ -204,9 +208,13 @@ def run(args) -> dict:
                     f"rel_volume {float(wire.rel_volume()):.4f}"
                 )
     except BaseException:
+        if profiling:
+            jax.profiler.stop_trace()
         if tracker is not None:
             tracker.finish({"status": "failed", "steps_completed": len(losses)})
         raise
+    if profiling:
+        jax.profiler.stop_trace()
     elapsed = time.perf_counter() - t0
 
     result = {
@@ -244,6 +252,10 @@ def main():
     ap.add_argument("--run_name", type=str, default="")
     ap.add_argument("--tags", type=str, default="",
                     help="comma-separated run tags (--extra_wandb_tags role)")
+    ap.add_argument("--profile_dir", type=str, default="",
+                    help="write a jax.profiler trace of the steady-state steps "
+                         "(the reference's --log_time timing role, but a real "
+                         "XLA trace instead of wall-clock prints)")
     run(ap.parse_args())
 
 

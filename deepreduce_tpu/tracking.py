@@ -15,11 +15,14 @@ WANDB dashboard when comparing configs across runs).
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import pathlib
 import time
 from typing import Any, Dict, Iterator, List, Optional
+
+_RUN_SEQ = itertools.count()  # disambiguates unnamed runs within one second
 
 
 def _jsonable(obj: Any) -> Any:
@@ -27,7 +30,9 @@ def _jsonable(obj: Any) -> Any:
         return {str(k): _jsonable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_jsonable(v) for v in obj]
-    if hasattr(obj, "item"):  # numpy / jax scalars
+    if hasattr(obj, "ndim"):  # numpy / jax arrays and scalars
+        return obj.item() if obj.ndim == 0 else _jsonable(obj.tolist())
+    if hasattr(obj, "item"):  # other 0-d scalar wrappers
         return obj.item()
     if isinstance(obj, (str, int, float, bool)) or obj is None:
         return obj
@@ -44,8 +49,10 @@ class Run:
         config: Optional[Dict[str, Any]] = None,
         tags: Optional[List[str]] = None,
     ):
-        stamp = time.strftime("%Y%m%d-%H%M%S")
-        self.name = name or f"run-{stamp}-{os.getpid()}"
+        if name is None:
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            name = f"run-{stamp}-{os.getpid()}-{next(_RUN_SEQ)}"
+        self.name = name
         self.dir = pathlib.Path(root) / self.name
         self.dir.mkdir(parents=True, exist_ok=True)
         self._metrics = open(self.dir / "metrics.jsonl", "a")

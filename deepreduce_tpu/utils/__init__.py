@@ -16,6 +16,25 @@ from deepreduce_tpu.codecs import packing
 from deepreduce_tpu.logging_utils import DumpLogger, policy_errors
 from deepreduce_tpu.metrics import WireStats, combine, payload_device_bytes
 
+
+def force_platform(platform: str, device_count: int = 8) -> None:
+    """Pin the JAX platform in-process. Env vars alone don't stick under the
+    axon TPU tunnel, so anything that needs the virtual CPU mesh (tests,
+    dry runs, CPU benchmarks) must set both the env *and* jax.config before
+    a backend initializes. `device_count` only applies to 'cpu'."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = platform
+    flags = os.environ.get("XLA_FLAGS", "")
+    if platform == "cpu" and "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={device_count}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
+
 __all__ = [
     "packing",
     "metrics",
@@ -25,4 +44,5 @@ __all__ = [
     "WireStats",
     "combine",
     "payload_device_bytes",
+    "force_platform",
 ]

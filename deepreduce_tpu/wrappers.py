@@ -171,8 +171,6 @@ class TensorCodec:
         if payload.mapping is None:
             mapping_arr = None
         else:
-            mapping_max = self.val_codec.both_mapping_max()
-            w = max(1, math.ceil(math.log2(max(2, mapping_max + 1))))
             mapping_arr = packing.unpack(payload.mapping, vk)
         vpay = self.val_codec.restore_for_both(payload.value_payload, mapping_arr)
         vsp = self.val_codec.decode(vpay, self.shape, step=step)  # codec-order values

@@ -6,7 +6,9 @@ import os
 
 # force CPU even when the ambient environment pins JAX_PLATFORMS (e.g. axon);
 # backends initialize lazily, so this works even though pytest plugins may
-# have already imported jax
+# have already imported jax. Deliberately self-contained (not
+# utils.force_platform): conftest must not import the package before the
+# backend assert below.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

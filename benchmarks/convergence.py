@@ -125,6 +125,12 @@ def main():
 
         force_platform(args.platform)
 
+    import jax
+
+    n_dev = min(8, len(jax.devices()))
+    if args.batch_size % n_dev:
+        ap.error(f"--batch_size must be divisible by the {n_dev}-device mesh")
+
     from deepreduce_tpu.config import DeepReduceConfig, from_params
 
     x, y = make_task(args.n_examples, args.dim, args.classes, args.seed)

@@ -16,12 +16,19 @@ sharding) over a `jax.sharding.Mesh` — ICI-native, no NCCL/MPI.
 - `tp`        — tensor-parallel GSPMD sharding rules (Megatron-style
                 column/row splits expressed as PartitionSpecs; XLA inserts
                 the collectives).
+- `hierarchical` — two-level ICI x DCN exchange: dense psum within a
+                slice, DeepReduce-compressed allgather across slices (the
+                multi-slice deployment of the communicator).
 """
 
 from deepreduce_tpu.parallel.mesh import factor_devices, make_mesh
 from deepreduce_tpu.parallel.ring import ring_attention
 from deepreduce_tpu.parallel.ulysses import ulysses_attention
 from deepreduce_tpu.parallel.tp import bert_tp_rules, tp_shardings
+from deepreduce_tpu.parallel.hierarchical import (
+    HierarchicalExchanger,
+    make_hybrid_mesh,
+)
 
 __all__ = [
     "factor_devices",
@@ -30,4 +37,6 @@ __all__ = [
     "ulysses_attention",
     "bert_tp_rules",
     "tp_shardings",
+    "HierarchicalExchanger",
+    "make_hybrid_mesh",
 ]

@@ -41,6 +41,11 @@ LSTM_D = 4_053_428  # StackOverflow LSTM param count (BASELINE.md)
 RESNET50_D = 25_557_032
 
 
+def _progress(msg: str) -> None:
+    """Stage progress to stderr (stdout stays the single JSON line)."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
 def _sync(x):
     import jax
 
@@ -76,10 +81,14 @@ def measure_config(d, ratio, cfg_kwargs, overhead, iters):
     key = jax.random.PRNGKey(0)
     encode = jax.jit(lambda t, s: codec.encode(t, step=s, key=key))
     decode = jax.jit(lambda p, s: codec.decode(p, step=s))
+    _progress(f"d={d} {cfg_kwargs.get('index') or 'topr'}: compiling encode")
     payload = _sync(encode(g, 0))
+    _progress(f"d={d}: compiling decode")
     _sync(decode(payload, 0))
+    _progress(f"d={d}: timing ({iters} iters)")
     t_enc = max(_timeit(encode, g, 1, iters=iters) - overhead, 0.0)
     t_dec = max(_timeit(decode, payload, 1, iters=iters) - overhead, 0.0)
+    _progress(f"d={d}: done enc={t_enc:.4f}s dec={t_dec:.4f}s")
     stats = codec.wire_stats(payload)
     return {
         "payload_bytes": float(stats.total_bits) / 8.0,
@@ -93,9 +102,36 @@ def exchange_time(m, bw):
     return m["payload_bytes"] / bw + m["t_encode_s"] + m["t_decode_s"]
 
 
+def _tpu_alive(timeout_s: float = 180.0) -> bool:
+    """True if a trivial device round-trip completes within `timeout_s`,
+    probed in a SUBPROCESS so a wedged axon tunnel (connection hang inside
+    jax.devices()) can't poison this process's jax backend state."""
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np;"
+        "v = jax.jit(lambda t: t * 2.0)(jnp.zeros((8,), jnp.float32));"
+        "np.asarray(v[:1])"
+    )
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     iters = 3 if quick else 7
+
+    degraded = not _tpu_alive()
+    if degraded:
+        _progress("device backend unresponsive after 180s; benching on CPU fallback")
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu")
 
     import jax
     import jax.numpy as jnp
@@ -148,6 +184,7 @@ def main() -> None:
             3,
         ),
         "platform": jax.devices()[0].platform,
+        "degraded_to_cpu": degraded,  # true = probe failed, NOT a TPU result
         "configs": {
             n: {
                 "rel_volume": round(m["rel_volume"], 5),

@@ -60,7 +60,7 @@ def accuracy(model, params, batch_stats, x, y, batch=256):
     return correct / len(x)
 
 
-def train_arm(cfg, x, y, steps, batch, lr, seed):
+def train_arm(cfg, x, y, steps, batch, lr, seed, n_dev):
     import jax
     import optax
     from jax.sharding import Mesh
@@ -80,7 +80,6 @@ def train_arm(cfg, x, y, steps, batch, lr, seed):
 
     classes = int(y.max()) + 1
     model = MLP(classes=classes)
-    n_dev = min(8, len(jax.devices()))
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
     trainer = Trainer(model, cfg, optax.sgd(lr, momentum=0.9), mesh)
     state = trainer.init_state(jax.random.PRNGKey(seed), (x[:batch], y[:batch]))
@@ -141,10 +140,10 @@ def main():
     comp_cfg = from_params(ast.literal_eval(args.grace_config))
 
     dense_acc, _ = train_arm(
-        dense_cfg, x, y, args.steps, args.batch_size, args.learning_rate, args.seed
+        dense_cfg, x, y, args.steps, args.batch_size, args.learning_rate, args.seed, n_dev
     )
     comp_acc, rel_volume = train_arm(
-        comp_cfg, x, y, args.steps, args.batch_size, args.learning_rate, args.seed
+        comp_cfg, x, y, args.steps, args.batch_size, args.learning_rate, args.seed, n_dev
     )
 
     print(json.dumps({

@@ -85,25 +85,40 @@ def _fit(y: jax.Array) -> jax.Array:
     root = jnp.sqrt(disc)
     p = 0.5 * (sol[1] + root)
     q = 0.5 * (sol[1] - root)
-    # clamp so e^{p·x} with x in (0,1] cannot overflow f32
+    # with peak-anchored evaluation (below) the basis lies in (0, 1]; the
+    # clamp only bounds how fast the far end may underflow to 0
     p = jnp.clip(p, -80.0, 80.0)
     q = jnp.clip(q, -80.0, 80.0)
 
-    beta = jnp.exp(p * x)
-    eta = jnp.exp(q * x)
-    m11 = jnp.sum(beta * beta)
-    m12 = jnp.sum(beta * eta)
-    m22 = jnp.sum(eta * eta)
-    m = jnp.array([[m11, m12], [m12, m22]], jnp.float32)
-    rhs = jnp.array([jnp.sum(beta * y), jnp.sum(eta * y)], jnp.float32)
-    amp = jnp.linalg.solve(m + 1e-7 * jnp.trace(m) * jnp.eye(2, dtype=jnp.float32) / 2.0, rhs)
-    return jnp.array([amp[0], amp[1], p, q], jnp.float32)
+    # Amplitude solve. The exponents can be large (steep tails give p ~ 15+),
+    # so the raw basis e^{p·x} spans many decades and its Gram matrix is
+    # rank-deficient in f32 — the fit collapses (amplitudes ~1e-6, curve ~0
+    # everywhere but the last points). Shift each exponential to peak at the
+    # end of its OWN growth direction (x=1 for a positive exponent, x=x[0]
+    # for a negative one) so every basis value lies in (0, 1] — no f32
+    # overflow for either sign — then column-normalize before the solve.
+    # The transmitted amplitudes A, C are the term values at the peak;
+    # `_anchor` + `_eval` reconstruct from the same convention, so neither
+    # side ever materializes e^{|p|}.
+    beta = jnp.exp(p * (x - _anchor(p, x)))
+    eta = jnp.exp(q * (x - _anchor(q, x)))
+    nb = jnp.sqrt(jnp.sum(beta * beta))
+    ne = jnp.sqrt(jnp.sum(eta * eta))
+    basis = jnp.stack([beta / nb, eta / ne], axis=1)
+    amp_n, _, _, _ = jnp.linalg.lstsq(basis, y)
+    return jnp.array([amp_n[0] / nb, amp_n[1] / ne, p, q], jnp.float32)
+
+
+def _anchor(exponent: jax.Array, x: jax.Array) -> jax.Array:
+    """Peak location of e^{exponent·x} on the grid: x[-1] when growing,
+    x[0] when decaying."""
+    return jnp.where(exponent >= 0, x[-1], x[0])
 
 
 def _eval(coeffs: jax.Array, k: int) -> jax.Array:
     x = jnp.arange(1, k + 1, dtype=jnp.float32) / jnp.float32(k)
     a, c, p, q = coeffs[0], coeffs[1], coeffs[2], coeffs[3]
-    return a * jnp.exp(p * x) + c * jnp.exp(q * x)
+    return a * jnp.exp(p * (x - _anchor(p, x))) + c * jnp.exp(q * (x - _anchor(q, x)))
 
 
 def encode(sp: SparseGrad, meta: DoubleExpMeta) -> DoubleExpPayload:

@@ -44,6 +44,11 @@ class DeepReduceConfig:
     use_pallas: bool = False  # pallas TPU kernels where applicable (QSGD PRNG)
     # small-tensor bypass (pytorch/deepreduce.py:68)
     min_compress_size: int = 1000
+    # per-layer whitelist: regex on the tensor's pytree path; non-matching
+    # tensors pass through uncompressed. The data-driven form of TF PolySeg's
+    # hard-coded conv-layer whitelist (tensorflow/deepreduce.py:458,526
+    # is_convolutional) — e.g. layer_pattern='Conv|kernel'
+    layer_pattern: Optional[str] = None
     # observability
     micro_benchmark: bool = False
 

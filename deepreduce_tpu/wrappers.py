@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import re
 from typing import Any, Optional, Tuple
 
 import jax
@@ -71,8 +72,20 @@ class TensorCodec:
         self.cfg = cfg
         self.name = name
         self.d = int(math.prod(self.shape)) if self.shape else 1
+        # Layers excluded by the whitelist pass through FULLY uncompressed —
+        # not even sparsified — the way TF PolySeg transmits non-conv layers
+        # as-is (tensorflow/deepreduce.py:515-516). The small-size gate is
+        # different: small tensors are still sparsified, just not
+        # codec-compressed (pytorch/deepreduce.py:68 returns the sparsifier
+        # output).
+        self.pattern_excluded = (
+            cfg.layer_pattern is not None
+            and re.search(cfg.layer_pattern, name) is None
+        )
         self.compressed = (
-            cfg.deepreduce is not None and self.d > cfg.min_compress_size
+            cfg.deepreduce is not None
+            and self.d > cfg.min_compress_size
+            and not self.pattern_excluded
         )
         if cfg.compressor == "none":
             self.k = self.d
@@ -103,6 +116,8 @@ class TensorCodec:
 
     def sparsify(self, tensor: jax.Array, *, key: Optional[jax.Array] = None) -> SparseGrad:
         cfg = self.cfg
+        if self.pattern_excluded:
+            return sparse.none_sparsifier(tensor)
         if cfg.compressor == "topk":
             return sparse.topk(tensor, cfg.compress_ratio, approx=cfg.approx_topk)
         if cfg.compressor == "randomk":
@@ -228,8 +243,10 @@ class TensorCodec:
         dense_bits = jnp.asarray(self.d * 32, jnp.float32)
         if not self.compressed:
             nnz = payload.nnz.astype(jnp.float32)
-            # a dense transmission (no sparsifier) carries no index stream
-            idx_bits = jnp.zeros(()) if self.cfg.compressor == "none" else nnz * 32
+            # a dense transmission (no sparsifier, or pattern-excluded layer)
+            # carries no index stream
+            dense_tx = self.cfg.compressor == "none" or self.pattern_excluded
+            idx_bits = jnp.zeros(()) if dense_tx else nnz * 32
             val_bits = nnz * 32
         elif self.cfg.deepreduce == "value":
             idx_bits = self.val_codec.index_wire_bits(payload)

@@ -200,3 +200,39 @@ def test_gzip_lossless_round_trip():
     out = gzip_codec.decode(payload, meta, sp.shape)
     np.testing.assert_array_equal(np.asarray(out.values), np.asarray(sp.values))
     np.testing.assert_array_equal(np.asarray(out.indices), np.asarray(sp.indices))
+
+
+def test_doubleexp_offset_curve_no_f32_collapse():
+    """Regression: exact-top-k magnitude curves start at the sparsification
+    threshold (large offset, no near-zero head). The steep-exponent fit used
+    to collapse in f32 (amplitudes ~1e-6, curve ~0 almost everywhere); the
+    shifted column-normalized amplitude solve keeps it at f64 quality."""
+    import numpy as np
+
+    from deepreduce_tpu.codecs import doubleexp
+
+    rng = np.random.default_rng(0)
+    g = (rng.normal(size=5000) * rng.random(5000) ** 2).astype(np.float32)
+    y = np.sort(np.abs(g))[-500:]
+    coeffs = doubleexp._fit(jnp.asarray(y))
+    fit = np.asarray(doubleexp._eval(coeffs, 500))
+    assert np.abs(fit - y).mean() < 0.05  # was 0.92 before the fix
+
+
+def test_doubleexp_negative_exponent_no_overflow():
+    """Regression: a decaying second exponential (q < 0, generic when the
+    4x4 solve returns sol[0] > 0) used to overflow sum(eta^2) in f32 at
+    q <= ~-44, silently zeroing that basis column; peak-anchored evaluation
+    keeps every basis value in (0, 1] for either sign."""
+    import numpy as np
+
+    from deepreduce_tpu.codecs import doubleexp
+
+    x = np.arange(1, 501, dtype=np.float32) / 500.0
+    # strongly decaying + strongly growing mixture forces q << 0 and p >> 0
+    y = (np.exp(-60.0 * x) + 0.1 * np.exp(8.0 * (x - 1.0))).astype(np.float32)
+    coeffs = doubleexp._fit(jnp.asarray(y))
+    assert np.all(np.isfinite(np.asarray(coeffs)))
+    fit = np.asarray(doubleexp._eval(coeffs, 500))
+    assert np.all(np.isfinite(fit))
+    assert np.abs(fit - y).mean() < 0.05

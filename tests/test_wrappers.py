@@ -131,3 +131,58 @@ def test_encode_decode_jit_stable():
     d1 = dec(p1, 0)
     d2 = dec(p2, 1)
     assert d1.shape == d2.shape
+
+
+def test_layer_pattern_whitelist():
+    """TF PolySeg applies only to whitelisted conv layers
+    (tensorflow/deepreduce.py:458,526); here the whitelist is a regex on the
+    tensor's pytree path."""
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    cfg = DeepReduceConfig(
+        deepreduce="index", index="integer", compress_ratio=0.1,
+        min_compress_size=100, layer_pattern="Conv",
+    )
+    conv = TensorCodec((64, 64), cfg, name="Conv_1/kernel")
+    dense = TensorCodec((64, 64), cfg, name="Dense_0/kernel")
+    assert conv.compressed
+    assert not dense.compressed
+
+    # excluded layers pass through FULLY dense — not even sparsified
+    # (tensorflow/deepreduce.py:515-516), unlike the small-size gate
+    import numpy as np
+    g = jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)).astype(np.float32))
+    payload = dense.encode(g, step=jnp.asarray(0))
+    out = np.asarray(dense.decode(payload, step=jnp.asarray(0)))
+    np.testing.assert_array_equal(out, np.asarray(g))
+    stats = dense.wire_stats(payload)
+    assert float(stats.rel_volume()) == 1.0  # dense bits, no index stream
+
+
+@pytest.mark.parametrize("index_codec", ["bloom", "rle", "integer", "huffman"])
+@pytest.mark.parametrize("value_codec", ["polyfit", "doubleexp", "qsgd"])
+def test_both_mode_full_matrix(index_codec, value_codec):
+    """Every index x value composition must round-trip with small top-coord
+    error — the reference allows arbitrary registry pairs in 'both' mode
+    (pytorch/deepreduce.py:36-46)."""
+    import numpy as np
+
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    d, ratio = 5000, 0.1
+    rng = np.random.default_rng(0)
+    g = jnp.asarray((rng.normal(size=d) * rng.random(d) ** 2).astype(np.float32))
+    cfg = DeepReduceConfig(
+        deepreduce="both", index=index_codec, value=value_codec,
+        compress_ratio=ratio, fpr=0.01, min_compress_size=100, memory="none",
+    )
+    codec = TensorCodec((d,), cfg, name="t")
+    payload = codec.encode(g, step=jnp.asarray(0), key=jax.random.PRNGKey(0))
+    out = np.asarray(codec.decode(payload, step=jnp.asarray(0)))
+    k = int(d * ratio)
+    top = np.argsort(-np.abs(np.asarray(g)))[:k]
+    err = np.abs(out[top] - np.asarray(g)[top]).mean()
+    # bloom pairs admit FP displacement error; exact-index codecs are tighter
+    assert err < (0.25 if index_codec == "bloom" else 0.08), err

@@ -26,6 +26,7 @@ shard_map'ped train step.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -48,9 +49,19 @@ class GradientExchanger:
     The role of the whole GRACE instance the reference builds in
     `deepreduce_from_params` (pytorch/deepreduce.py:28-48)."""
 
-    def __init__(self, grads_like: Any, cfg: DeepReduceConfig, *, axis_name: str = "data"):
+    def __init__(
+        self,
+        grads_like: Any,
+        cfg: DeepReduceConfig,
+        *,
+        axis_name: str = "data",
+        num_workers: Optional[int] = None,
+    ):
         self.cfg = cfg
         self.axis_name = axis_name
+        # static mesh-axis size; required only by communicator='qar' (its
+        # all_to_all reshape needs a static worker count)
+        self.num_workers = num_workers
         leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
         self.names = [_leaf_name(path) for path, _ in leaves]
         self.codecs: Dict[str, TensorCodec] = {
@@ -61,7 +72,9 @@ class GradientExchanger:
     # ------------------------------------------------------------------ #
 
     def init_state(self, grads_like: Any) -> Any:
-        if self.cfg.memory == "residual":
+        # qar never reads residuals (its quantization is unbiased) — don't
+        # allocate a full-model zero pytree per worker just to carry it
+        if self.cfg.memory == "residual" and self.cfg.communicator != "qar":
             return memory.init(grads_like)
         return None
 
@@ -82,6 +95,9 @@ class GradientExchanger:
         grads, new residual state, combined wire stats)."""
         cfg = self.cfg
         num_workers = jax.lax.psum(1, self.axis_name)
+
+        if cfg.communicator == "qar":
+            return self._exchange_qar(grads, state, step=step, key=key)
 
         if cfg.communicator == "allreduce" or cfg.deepreduce is None and cfg.compressor == "none":
             # dense baseline: NCCL allreduce -> psum (run_deepreduce.sh:51)
@@ -144,11 +160,73 @@ class GradientExchanger:
             new_state = memory.update(compensated, own)
         return agg, new_state, combine(stats_per)
 
+    def _exchange_qar(
+        self, grads: Any, state: Any, *, step: jax.Array, key: Optional[jax.Array]
+    ) -> Tuple[Any, Any, WireStats]:
+        """Quantized allreduce (qar.py): the whole pytree flattened into ONE
+        int8 two-phase exchange — no sparsifier, no residual (unbiased)."""
+        from deepreduce_tpu import qar
+
+        cfg = self.cfg
+        if self.num_workers is None:
+            raise ValueError(
+                "communicator='qar' needs the static mesh size: construct "
+                "GradientExchanger(..., num_workers=mesh.shape[axis])"
+            )
+        leaves = jax.tree_util.tree_leaves(grads)
+        flat = jnp.concatenate([l.reshape(-1) for l in leaves])
+        d = flat.shape[0]
+        n = qar.pad_len(d, self.num_workers, cfg.bucket_size)
+        padded = jnp.zeros((n,), flat.dtype).at[:d].set(flat)
+        if key is None:
+            key = jax.random.PRNGKey(cfg.seed)
+        key = jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
+        mean = qar.quantized_allreduce(
+            padded,
+            self.axis_name,
+            self.num_workers,
+            key=key,
+            quantum_num=cfg.quantum_num,
+            bucket_size=cfg.bucket_size,
+            use_pallas=cfg.use_pallas,
+        )[:d]
+        out, offset = [], 0
+        for leaf in leaves:
+            size = int(math.prod(leaf.shape)) if leaf.shape else 1
+            out.append(mean[offset : offset + size].reshape(leaf.shape))
+            offset += size
+        agg = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(grads), out
+        )
+        # one payload (int8 levels + f32 norms) per phase-equivalent dense
+        # transmission: rel_volume = payload_bits / dense_bits, the same
+        # convention the allreduce branch uses (the ring's (W-1)/W factor is
+        # identical for both sides of the ratio and cancels)
+        payload_bits = n * 8 + (n // cfg.bucket_size) * 32
+        stats = WireStats(
+            index_bits=jnp.zeros(()),
+            value_bits=jnp.asarray(payload_bits, jnp.float32),
+            dense_bits=jnp.asarray(d * 32, jnp.float32),
+        )
+        return agg, state, stats
+
     # ------------------------------------------------------------------ #
 
     def payload_bytes(self, grads_like: Any) -> int:
-        """Static allgather buffer size per worker (bytes) — what actually
-        crosses ICI each step."""
+        """Static per-worker wire bytes — what actually crosses ICI each
+        step for the configured communicator."""
+        if self.cfg.communicator == "qar":
+            from deepreduce_tpu import qar
+
+            d = sum(
+                int(math.prod(l.shape)) if l.shape else 1
+                for l in jax.tree_util.tree_leaves(grads_like)
+            )
+            if self.num_workers is None:
+                raise ValueError("qar payload accounting needs num_workers")
+            return int(
+                qar.wire_bits_per_worker(d, self.num_workers, self.cfg.bucket_size) // 8
+            )
         total = 0
         flat = dict(zip(self.names, jax.tree_util.tree_leaves(grads_like)))
         for name, codec in self.codecs.items():

@@ -25,8 +25,10 @@ class DeepReduceConfig:
     memory: str = "residual"  # residual | none
     beta: float = 1.0
     gamma: float = 1.0
-    # collective (GRACE 'communicator' role)
-    communicator: str = "allgather"  # allgather | allreduce
+    # collective (GRACE 'communicator' role). 'qar' = int8 quantized
+    # reduce-scatter+allgather (qar.py) — a TPU-native third shape beyond
+    # the reference's two
+    communicator: str = "allgather"  # allgather | allreduce | qar
     # DeepReduce wrapper mode (README.md:31-35)
     deepreduce: Optional[str] = None  # None | 'value' | 'index' | 'both'
     value: str = "polyfit"  # polyfit | doubleexp | qsgd | gzip

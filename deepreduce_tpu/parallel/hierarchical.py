@@ -67,10 +67,13 @@ class HierarchicalExchanger:
     `GradientExchanger.exchange`, for use inside shard_map over BOTH axes."""
 
     def __init__(self, grads_like: Any, cfg: DeepReduceConfig, *,
-                 dcn_axis: str = "dcn", ici_axis: str = "ici"):
+                 dcn_axis: str = "dcn", ici_axis: str = "ici",
+                 num_slices: Optional[int] = None):
         self.ici_axis = ici_axis
         self.dcn_axis = dcn_axis
-        self.exchanger = GradientExchanger(grads_like, cfg, axis_name=dcn_axis)
+        self.exchanger = GradientExchanger(
+            grads_like, cfg, axis_name=dcn_axis, num_workers=num_slices
+        )
 
     def init_state(self, grads_like: Any) -> Any:
         return self.exchanger.init_state(grads_like)

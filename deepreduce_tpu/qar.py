@@ -1,0 +1,140 @@
+"""Quantized allreduce — int8 reduce-scatter + allgather over a mesh axis.
+
+The reference has exactly two collectives: dense fp32 allreduce (baseline)
+and allgather-of-compressed-payloads, where every worker receives every
+other worker's payload and decodes all W of them (SURVEY.md §2.5). A TPU
+mesh admits a third shape the reference's Horovod world can't express — the
+one EQuARX-style quantized XLA collectives use (PAPERS.md): quantize
+*inside* the collective.
+
+    phase 1 (reduce-scatter): g reshaped [W, s]; every shard QSGD-bucket
+        quantized to int8 + f32 bucket norms; `all_to_all` routes shard i
+        of every worker to worker i; dequantize the W received rows and
+        sum -> worker i owns the aggregated shard i.
+    phase 2 (allgather): the aggregated shard is re-quantized and
+        `all_gather`ed; every worker dequantizes W shards back into the
+        full mean gradient.
+
+Wire cost per worker ~ 2·(W-1)/W·d int8 bytes (+ 1 f32 norm per 512-bucket),
+vs 8·(W-1)/W·d bytes for fp32 ring allreduce — ~4x less traffic — and vs the
+reference scheme's W-fold receive volume. Quantization is unbiased
+(stochastic rounding, E[q(x)] = x) at both phases, so this works on *dense*
+gradients with no sparsifier and no residual memory. One fused buffer
+carries the whole gradient pytree.
+
+`GradientExchanger` exposes this as ``communicator='qar'``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _bucket_quantize(
+    flat: jax.Array,
+    quantum_num: int,
+    bucket_size: int,
+    key: jax.Array,
+    use_pallas: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """QSGD-style per-bucket stochastic quantization of a [n] vector (n a
+    static multiple of bucket_size) -> (int8[n] levels, f32[n/bucket] norms).
+    Delegates the floor+Bernoulli int8 step to `ops.quantize_levels` (one
+    quantizer implementation, incl. the Pallas hardware-PRNG fast path)."""
+    from deepreduce_tpu.ops import quantize_levels
+
+    if quantum_num > 127:
+        raise ValueError(
+            f"quantum_num={quantum_num} does not fit the int8 wire (max 127); "
+            "levels would wrap and flip gradient signs"
+        )
+    buckets = flat.reshape(-1, bucket_size)
+    norms = jnp.linalg.norm(buckets, axis=1)
+    safe = jnp.where(norms > 0, norms, 1.0)
+    scale = jnp.broadcast_to((quantum_num / safe)[:, None], buckets.shape).reshape(-1)
+    levels = quantize_levels(flat, scale, key, use_pallas=use_pallas)
+    return levels, norms
+
+
+def _bucket_dequantize(
+    levels: jax.Array, norms: jax.Array, quantum_num: int, bucket_size: int
+) -> jax.Array:
+    b = levels.reshape(-1, bucket_size).astype(jnp.float32)
+    return (b * (norms / quantum_num)[:, None]).reshape(-1)
+
+
+def pad_len(d: int, num_workers: int, bucket_size: int) -> int:
+    """Padded length: a whole number of buckets per worker shard."""
+    shard = -(-d // num_workers)  # ceil
+    shard = -(-shard // bucket_size) * bucket_size
+    return shard * num_workers
+
+
+def wire_bits_per_worker(d: int, num_workers: int, bucket_size: int) -> float:
+    """Bytes-on-ICI accounting: int8 levels + f32 norms actually sent by one
+    worker across both phases (ring collectives transmit the (W-1)/W
+    fraction)."""
+    n = pad_len(d, num_workers, bucket_size)
+    payload_bits = n * 8 + (n // bucket_size) * 32
+    return 2.0 * payload_bits * (num_workers - 1) / max(1, num_workers)
+
+
+def quantized_allreduce(
+    flat: jax.Array,
+    axis_name: str,
+    num_workers: int,
+    *,
+    key: jax.Array,
+    quantum_num: int = 127,
+    bucket_size: int = 512,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """Mean of `flat` over `axis_name` via the int8 two-phase exchange.
+
+    `flat` must be zero-padded to `pad_len(d, num_workers, bucket_size)`;
+    `num_workers` is the static mesh-axis size (shapes must be static under
+    jit — the traced `psum(1, axis)` cannot drive a reshape). Call inside
+    shard_map over `axis_name`. Returns the elementwise mean.
+    """
+    if quantum_num > 127:
+        raise ValueError(
+            f"quantum_num={quantum_num} does not fit the int8 wire (max 127); "
+            "levels would wrap and flip gradient signs"
+        )
+    n = flat.shape[0]
+    if n % (num_workers * bucket_size):
+        raise ValueError(
+            f"flat length {n} not a multiple of W*bucket = {num_workers * bucket_size}; "
+            "pad with pad_len()"
+        )
+    shard = n // num_workers
+    widx = jax.lax.axis_index(axis_name)
+
+    # --- phase 1: quantize, all_to_all shards to their owners, reduce ----
+    levels, norms = _bucket_quantize(
+        flat, quantum_num, bucket_size, jax.random.fold_in(key, widx), use_pallas
+    )
+    lv = levels.reshape(num_workers, shard)
+    nm = norms.reshape(num_workers, shard // bucket_size)
+    # tiled all_to_all: row j of every worker lands on worker j; the
+    # received rows stack along the same axis -> [W, shard] where row w is
+    # worker w's contribution to MY shard
+    lv_rx = jax.lax.all_to_all(lv, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    nm_rx = jax.lax.all_to_all(nm, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    contrib = jax.vmap(
+        lambda l, s: _bucket_dequantize(l, s, quantum_num, bucket_size)
+    )(lv_rx, nm_rx)
+    own_sum = jnp.sum(contrib, axis=0)  # aggregated shard owned by this worker
+
+    # --- phase 2: re-quantize the aggregate, allgather, dequantize -------
+    k2 = jax.random.fold_in(jax.random.fold_in(key, widx), jnp.uint32(0x5EED))
+    lv2, nm2 = _bucket_quantize(own_sum, quantum_num, bucket_size, k2, use_pallas)
+    lv_all = jax.lax.all_gather(lv2, axis_name)  # [W, shard]
+    nm_all = jax.lax.all_gather(nm2, axis_name)
+    full = jax.vmap(
+        lambda l, s: _bucket_dequantize(l, s, quantum_num, bucket_size)
+    )(lv_all, nm_all).reshape(n)
+    return full / num_workers

@@ -137,7 +137,9 @@ class Trainer:
             variables = self.model.init(rng, sample_input)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
-        self.exchanger = GradientExchanger(params, self.cfg, axis_name=self.axis_name)
+        self.exchanger = GradientExchanger(
+            params, self.cfg, axis_name=self.axis_name, num_workers=self.num_workers
+        )
         residuals = self.exchanger.init_state(params)
         if residuals is not None:
             # worker-local residual: leading [num_workers] axis, sharded

@@ -1,0 +1,144 @@
+"""Quantized allreduce (qar.py): int8 reduce-scatter + allgather on the
+virtual 8-device mesh — accuracy vs the exact mean, unbiasedness of the
+two-phase quantization, wire accounting, and the communicator='qar'
+trainer path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepreduce_tpu import qar
+from deepreduce_tpu.config import DeepReduceConfig
+
+W = 8
+D = 6000  # deliberately NOT a multiple of W*bucket
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:W]), ("data",))
+
+
+def _run_qar(grads, key, bucket=512):
+    n = qar.pad_len(D, W, bucket)
+    padded = np.zeros((W, n), np.float32)
+    padded[:, :D] = grads
+
+    def spmd(g):
+        return qar.quantized_allreduce(
+            g.reshape(n), "data", W, key=key, bucket_size=bucket
+        )
+
+    fn = jax.jit(
+        shard_map(
+            spmd, mesh=_mesh(), in_specs=(P("data"),), out_specs=P("data"),
+            check_rep=False,
+        )
+    )
+    out = np.asarray(fn(jnp.asarray(padded))).reshape(W, n)[:, :D]
+    return out
+
+
+def test_qar_close_to_exact_mean():
+    rng = np.random.default_rng(0)
+    grads = rng.normal(size=(W, D)).astype(np.float32)
+    out = _run_qar(grads, jax.random.PRNGKey(3))
+    want = grads.mean(axis=0)
+    # every worker reconstructs the same mean
+    for row in out[1:]:
+        np.testing.assert_array_equal(row, out[0])
+    # two-phase 127-level bucket-512 quantization on Gaussian data has
+    # ~7.3% relative error per phase (step = ||v||/127 ~ sqrt(512)sigma/127,
+    # stochastic-rounding std ~ step/sqrt(6)); two independent phases
+    # compose to ~10%. Anything well past that indicates a scale bug.
+    rel = np.linalg.norm(out[0] - want) / np.linalg.norm(want)
+    assert rel < 0.15, rel
+
+
+def test_qar_unbiased_over_keys():
+    rng = np.random.default_rng(1)
+    grads = rng.normal(size=(W, D)).astype(np.float32)
+    want = grads.mean(axis=0)
+    acc = np.zeros(D, np.float64)
+    trials = 12
+    for t in range(trials):
+        acc += _run_qar(grads, jax.random.PRNGKey(100 + t))[0]
+    est = acc / trials
+    # E[qar] = mean: averaging over keys must beat any single trial
+    single = np.abs(_run_qar(grads, jax.random.PRNGKey(500))[0] - want).mean()
+    assert np.abs(est - want).mean() < 0.5 * single
+
+
+def test_qar_wire_accounting_quarter_of_dense():
+    bits = qar.wire_bits_per_worker(D, W, 512)
+    n = qar.pad_len(D, W, 512)
+    dense_bits = 2.0 * (W - 1) / W * n * 32
+    ratio = bits / dense_bits
+    assert 0.2 < ratio < 0.3  # int8 + norm overhead ~ 0.26
+
+
+def test_qar_pad_len_contract():
+    assert qar.pad_len(6000, 8, 512) % (8 * 512) == 0
+    assert qar.pad_len(6000, 8, 512) >= 6000
+    with pytest.raises(ValueError):
+        qar.quantized_allreduce(
+            jnp.zeros((100,)), "data", 8, key=jax.random.PRNGKey(0)
+        )
+
+
+def test_trainer_qar_communicator_learns():
+    import flax.linen as nn
+
+    from deepreduce_tpu.train import Trainer
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(64)(x))
+            return nn.Dense(4)(x)
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(16, 4))
+    x = rng.normal(size=(512, 16)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+
+    cfg = DeepReduceConfig(communicator="qar", memory="none", deepreduce=None,
+                           compressor="none")
+    trainer = Trainer(MLP(), cfg, optax.sgd(0.1), _mesh())
+    state = trainer.init_state(jax.random.PRNGKey(0), (x[:64], y[:64]))
+    losses = []
+    for i in range(40):
+        lo = (i * 64) % (len(x) - 64)
+        state, loss, wire = trainer.step(
+            state, (x[lo : lo + 64], y[lo : lo + 64]), jax.random.PRNGKey(i)
+        )
+        losses.append(float(loss))
+    # tracks the dense trajectory (measured: identical 0.48 ratio at 40 steps)
+    assert losses[-1] < 0.6 * losses[0]
+    # at this tiny d (1348 padded to 4096) padding dominates the accounting;
+    # still strictly cheaper than dense, and -> ~0.26 as d >> W*bucket
+    assert float(wire.rel_volume()) < 1.0
+
+
+def test_qar_quantum_num_over_int8_rejected():
+    with pytest.raises(ValueError, match="int8"):
+        qar.quantized_allreduce(
+            jnp.zeros((8 * 512,)), "data", 8, key=jax.random.PRNGKey(0),
+            quantum_num=200,
+        )
+
+
+def test_qar_no_residual_state_and_wire_bytes():
+    from deepreduce_tpu.comm import GradientExchanger
+
+    cfg = DeepReduceConfig(communicator="qar", memory="residual")
+    grads = {"w": jnp.zeros((D,))}
+    ex = GradientExchanger(grads, cfg, num_workers=W)
+    assert ex.init_state(grads) is None  # unbiased path carries no residual
+    n = qar.pad_len(D, W, 512)
+    want = int(qar.wire_bits_per_worker(D, W, 512) // 8)
+    assert ex.payload_bytes(grads) == want
+    assert want < D * 4  # cheaper than one dense fp32 gradient

@@ -122,6 +122,53 @@ def _tpu_alive(timeout_s: float = 180.0) -> bool:
         return False
 
 
+def _resnet50_images_per_sec(overhead: float, batch: int = 32) -> dict:
+    """Full training-step throughput, dense vs topk-1%-compressed, on the
+    single available chip (mesh of 1; the codec + exchange cost is real,
+    the collective degenerates)."""
+    import jax
+    import optax
+    from jax.sharding import Mesh
+
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.models import ResNet50
+    from deepreduce_tpu.train import Trainer
+
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(batch, 224, 224, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, batch).astype(np.int32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    out = {}
+    for name, cfg in {
+        "dense": DeepReduceConfig(
+            compressor="none", deepreduce=None, memory="none", communicator="allreduce"
+        ),
+        "topk1_bloom": DeepReduceConfig(
+            compressor="topk", compress_ratio=0.01, approx_topk=True,
+            memory="residual", deepreduce="index", index="bloom",
+            fpr=0.001, bloom_blocked=True,
+        ),
+    }.items():
+        _progress(f"resnet50 {name}: compiling step")
+        trainer = Trainer(ResNet50(num_classes=1000), cfg, optax.sgd(0.1), mesh)
+        state = trainer.init_state(jax.random.PRNGKey(0), (images, labels))
+        step = lambda s, i: trainer.step(s, (images, labels), jax.random.PRNGKey(i))
+        state, _, _ = step(state, 0)
+        _sync(state.params)
+        best = float("inf")
+        for i in range(3):
+            t0 = time.perf_counter()
+            state, loss, _ = step(state, i + 1)
+            _sync(state.params)
+            best = min(best, time.perf_counter() - t0)
+        out[name] = round(batch / max(best - overhead, 1e-9), 2)
+        _progress(f"resnet50 {name}: {out[name]} img/s")
+    out["compression_overhead_pct"] = round(
+        100.0 * (out["dense"] / max(out["topk1_bloom"], 1e-9) - 1.0), 1
+    )
+    return out
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     iters = 3 if quick else 7
@@ -208,7 +255,20 @@ def main() -> None:
             "rel_volume": round(r50["rel_volume"], 5),
             "t_encode_s": round(r50["t_encode_s"], 4),
             "t_decode_s": round(r50["t_decode_s"], 4),
+            # effective gradient-exchange bandwidth: dense bytes made
+            # exchangeable per second of codec work (the BASELINE.md
+            # north-star framing)
+            "effective_exchange_GBps": round(
+                4.0 * RESNET50_D / max(r50["t_encode_s"] + r50["t_decode_s"], 1e-9) / 1e9,
+                2,
+            ),
         }
+
+    if "--resnet50" in sys.argv:
+        # ResNet-50 images/sec at topk 1% (BASELINE.md north-star metric):
+        # full fwd+bwd+compressed-exchange step on the available chip.
+        # Opt-in — the fwd/bwd compile is minutes through a cold tunnel.
+        detail["resnet50_images_per_sec"] = _resnet50_images_per_sec(overhead)
 
     print(
         json.dumps(

@@ -35,3 +35,10 @@ $PY benchmarks/train.py --model ncf --num_steps $STEPS --batch_size 256 \
 echo "== BERT-base allgather stress (new config, BASELINE.json #5) =="
 $PY benchmarks/train.py --model bert --num_steps 3 --batch_size 8 \
   --grace_config "{'compressor':'topk','compress_ratio':0.001,'memory':'residual','communicator':'allgather','deepreduce':'both','index':'bloom','value':'polyfit','fpr':0.001,'bloom_blocked':True}"
+
+echo "== Quantized allreduce (int8 in-collective, qar.py; beyond the reference) =="
+$PY benchmarks/train.py --model resnet20 --num_steps $STEPS \
+  --grace_config "{'communicator':'qar','compressor':'none','memory':'none','quantum_num':127,'bucket_size':512}"
+
+echo "== Convergence parity (Table-1 methodology, dense vs compressed arm) =="
+$PY benchmarks/convergence.py --steps ${CONV_STEPS:-150}

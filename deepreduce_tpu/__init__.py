@@ -20,7 +20,17 @@ fixed-budget payloads with in-band length words so XLA collectives get static
 shapes.
 """
 
-from deepreduce_tpu import codecs, comm, config, memory, metrics, parallel, sparse
+from deepreduce_tpu import (
+    codecs,
+    comm,
+    config,
+    memory,
+    metrics,
+    parallel,
+    qar,
+    sparse,
+    tracking,
+)
 from deepreduce_tpu.config import DeepReduceConfig, from_params
 from deepreduce_tpu.fedavg import FedAvg, FedAvgState, FedConfig
 from deepreduce_tpu.sparse import SparseGrad
@@ -40,5 +50,7 @@ __all__ = [
     "memory",
     "metrics",
     "parallel",
+    "qar",
     "sparse",
+    "tracking",
 ]

@@ -48,15 +48,23 @@ class QSGDPayload:
     nnz: jax.Array
 
 
+def bucket_scale(flat: jax.Array, quantum_num: int, bucket_size: int) -> Tuple[jax.Array, jax.Array]:
+    """Per-bucket quantization geometry shared by this codec and the
+    quantized-allreduce path (qar.py): (scale[n], norms[n/bucket]) with the
+    zero-norm guard. `flat` length must be a multiple of bucket_size."""
+    buckets = flat.reshape(-1, bucket_size)
+    norms = jnp.linalg.norm(buckets, axis=1)
+    safe = jnp.where(norms > 0, norms, 1.0)
+    scale = jnp.broadcast_to((quantum_num / safe)[:, None], buckets.shape).reshape(-1)
+    return scale, norms
+
+
 def encode(sp: SparseGrad, meta: QSGDMeta, key: jax.Array) -> QSGDPayload:
     from deepreduce_tpu.ops import quantize_levels
 
     b, bs, q = meta.num_buckets, meta.bucket_size, meta.quantum_num
     padded = jnp.zeros((b * bs,), jnp.float32).at[: meta.k].set(sp.values)
-    buckets = padded.reshape(b, bs)
-    norms = jnp.linalg.norm(buckets, axis=1)
-    safe = jnp.where(norms > 0, norms, 1.0)
-    scale = jnp.broadcast_to((q / safe)[:, None], buckets.shape).reshape(-1)
+    scale, norms = bucket_scale(padded, q, bs)
     levels_i8 = quantize_levels(padded, scale, key, use_pallas=meta.use_pallas).reshape(b, bs)
     norm_bytes = jax.lax.bitcast_convert_type(norms, jnp.uint8).astype(jnp.int8)  # [B, 4]
     data = jnp.concatenate([levels_i8, norm_bytes], axis=1).reshape(-1)

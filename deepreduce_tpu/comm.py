@@ -62,6 +62,16 @@ class GradientExchanger:
         # static mesh-axis size; required only by communicator='qar' (its
         # all_to_all reshape needs a static worker count)
         self.num_workers = num_workers
+        if cfg.communicator == "qar" and (
+            cfg.deepreduce is not None or cfg.compressor not in ("none",)
+        ):
+            raise ValueError(
+                "communicator='qar' quantizes the DENSE gradient inside the "
+                "collective and never runs the sparsifier or codecs; "
+                f"compressor={cfg.compressor!r} / deepreduce={cfg.deepreduce!r} "
+                "would be silently ignored — use compressor='none', "
+                "deepreduce=None (or a different communicator)"
+            )
         leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
         self.names = [_leaf_name(path) for path, _ in leaves]
         self.codecs: Dict[str, TensorCodec] = {
@@ -173,11 +183,15 @@ class GradientExchanger:
                 "communicator='qar' needs the static mesh size: construct "
                 "GradientExchanger(..., num_workers=mesh.shape[axis])"
             )
-        leaves = jax.tree_util.tree_leaves(grads)
-        flat = jnp.concatenate([l.reshape(-1) for l in leaves])
+        from jax.flatten_util import ravel_pytree
+
+        flat, unravel = ravel_pytree(grads)
         d = flat.shape[0]
         n = qar.pad_len(d, self.num_workers, cfg.bucket_size)
-        padded = jnp.zeros((n,), flat.dtype).at[:d].set(flat)
+        # quantization scales and dequantized sums are f32; cast up front so
+        # bf16 inputs get f32 bucket norms, and hand leaves back in their own
+        # dtype like the psum branch does
+        padded = jnp.zeros((n,), jnp.float32).at[:d].set(flat.astype(jnp.float32))
         if key is None:
             key = jax.random.PRNGKey(cfg.seed)
         key = jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
@@ -190,14 +204,7 @@ class GradientExchanger:
             bucket_size=cfg.bucket_size,
             use_pallas=cfg.use_pallas,
         )[:d]
-        out, offset = [], 0
-        for leaf in leaves:
-            size = int(math.prod(leaf.shape)) if leaf.shape else 1
-            out.append(mean[offset : offset + size].reshape(leaf.shape))
-            offset += size
-        agg = jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(grads), out
-        )
+        agg = unravel(mean.astype(flat.dtype))
         # one payload (int8 levels + f32 norms) per phase-equivalent dense
         # transmission: rel_volume = payload_bits / dense_bits, the same
         # convention the allreduce branch uses (the ring's (W-1)/W factor is

@@ -42,8 +42,10 @@ def _bucket_quantize(
 ) -> Tuple[jax.Array, jax.Array]:
     """QSGD-style per-bucket stochastic quantization of a [n] vector (n a
     static multiple of bucket_size) -> (int8[n] levels, f32[n/bucket] norms).
-    Delegates the floor+Bernoulli int8 step to `ops.quantize_levels` (one
-    quantizer implementation, incl. the Pallas hardware-PRNG fast path)."""
+    Shares the bucket geometry (codecs.qsgd.bucket_scale) and the
+    floor+Bernoulli int8 step (ops.quantize_levels, incl. the Pallas
+    hardware-PRNG fast path) with the QSGD codec — one quantizer."""
+    from deepreduce_tpu.codecs.qsgd import bucket_scale
     from deepreduce_tpu.ops import quantize_levels
 
     if quantum_num > 127:
@@ -51,10 +53,7 @@ def _bucket_quantize(
             f"quantum_num={quantum_num} does not fit the int8 wire (max 127); "
             "levels would wrap and flip gradient signs"
         )
-    buckets = flat.reshape(-1, bucket_size)
-    norms = jnp.linalg.norm(buckets, axis=1)
-    safe = jnp.where(norms > 0, norms, 1.0)
-    scale = jnp.broadcast_to((quantum_num / safe)[:, None], buckets.shape).reshape(-1)
+    scale, norms = bucket_scale(flat, quantum_num, bucket_size)
     levels = quantize_levels(flat, scale, key, use_pallas=use_pallas)
     return levels, norms
 

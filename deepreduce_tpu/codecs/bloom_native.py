@@ -1,0 +1,136 @@
+"""Native (C++) bloom index codec — the reference's BloomCPU registry slot.
+
+The reference ships two bloom implementations: the GPU/CuPy one and a
+host-library one reachable from the same codec registry
+(/root/reference/pytorch/deepreduce.py:696-736 `BloomCPU`, :913-922). Here
+the host implementation is `native/deepreduce_native.cc` (the role of the
+reference's C++ TF ops bloom_filter_compression.cc) reached through
+`jax.pure_callback` with a static wire budget, so it composes with jit and
+the allgather like every other codec. This is also the only route to the
+P2 `conflict_sets` policy, which is native-only in the reference too
+(policies.hpp:43-146; SURVEY.md §2.6).
+
+Wire format is the C++ layer's own: ``[int32 m | int32 h | int32 count |
+count x int32 values | m/8 bytes bit-array]`` (bloom_filter_compression.cc:
+112-141 shape), padded to the static budget with an in-band byte length.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepreduce_tpu.codecs import bloom as bloom_jax
+from deepreduce_tpu.sparse import SparseGrad
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomNativeMeta:
+    k: int
+    d: int
+    m_bits: int
+    num_hash: int
+    fpr: float
+    policy: str
+    budget: int  # selected-index cap (p0: Lemma-6 bound)
+
+    @classmethod
+    def create(cls, k: int, d: int, fpr: Optional[float], policy: str) -> "BloomNativeMeta":
+        m_bits, num_hash, fpr_eff = bloom_jax.bloom_config(k, d, fpr)
+        return cls(
+            k=k, d=d, m_bits=m_bits, num_hash=num_hash, fpr=fpr_eff,
+            policy=policy, budget=bloom_jax.policy_budget(policy, k, d, fpr_eff),
+        )
+
+    @property
+    def wire_budget(self) -> int:
+        return 12 + self.budget * 4 + self.m_bits // 8
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BloomNativePayload:
+    wire: jax.Array  # int8[wire_budget] — C++ wire bytes, zero-padded
+    nbytes: jax.Array  # i32[] — live wire length
+    values: jax.Array  # f32[budget] — selected values (also inside wire)
+    nsel: jax.Array  # i32[] — live selected count
+
+
+def encode(
+    sp: SparseGrad,
+    dense: Optional[jax.Array],
+    meta: BloomNativeMeta,
+    *,
+    step: jax.Array = 0,
+) -> BloomNativePayload:
+    from deepreduce_tpu import native
+
+    if dense is None:
+        dense = sp.to_dense()
+
+    def host(dense_np, idx_np, nnz_np, step_np):
+        idx = np.asarray(idx_np, np.int32)[: int(nnz_np)]
+        wire = native.bloom_compress(
+            np.asarray(dense_np, np.float32).reshape(-1), idx,
+            meta.m_bits, meta.num_hash, meta.policy, int(step_np), meta.budget,
+        )
+        vals, sel = native.bloom_decompress(
+            wire, meta.d, meta.k, meta.policy, int(step_np), meta.budget
+        )
+        out_wire = np.zeros(meta.wire_budget, np.int8)
+        out_wire[: len(wire)] = wire
+        out_vals = np.zeros(meta.budget, np.float32)
+        out_vals[: len(vals)] = vals
+        return out_wire, np.int32(len(wire)), out_vals, np.int32(len(sel))
+
+    wire, nbytes, values, nsel = jax.pure_callback(
+        host,
+        (
+            jax.ShapeDtypeStruct((meta.wire_budget,), jnp.int8),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((meta.budget,), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        ),
+        dense.reshape(-1), sp.indices, sp.nnz, jnp.asarray(step, jnp.int32),
+    )
+    return BloomNativePayload(wire=wire, nbytes=nbytes, values=values, nsel=nsel)
+
+
+def decode(
+    payload: BloomNativePayload,
+    meta: BloomNativeMeta,
+    shape: Tuple[int, ...],
+    *,
+    step: jax.Array = 0,
+) -> SparseGrad:
+    from deepreduce_tpu import native
+
+    def host(wire_np, nbytes_np, step_np):
+        wire = np.asarray(wire_np, np.int8)[: int(nbytes_np)]
+        vals, idxs = native.bloom_decompress(
+            wire, meta.d, meta.k, meta.policy, int(step_np), meta.budget
+        )
+        out_v = np.zeros(meta.budget, np.float32)
+        out_i = np.zeros(meta.budget, np.int32)
+        out_v[: len(vals)] = vals
+        out_i[: len(idxs)] = idxs
+        return out_v, out_i, np.int32(len(idxs))
+
+    vals, idxs, nsel = jax.pure_callback(
+        host,
+        (
+            jax.ShapeDtypeStruct((meta.budget,), jnp.float32),
+            jax.ShapeDtypeStruct((meta.budget,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        ),
+        payload.wire, payload.nbytes, jnp.asarray(step, jnp.int32),
+    )
+    return SparseGrad(values=vals, indices=idxs, nnz=nsel, shape=shape)
+
+
+def wire_bits(payload: BloomNativePayload, meta: BloomNativeMeta) -> jax.Array:
+    return payload.nbytes.astype(jnp.float32) * 8.0

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from deepreduce_tpu.codecs import (
     bloom,
+    bloom_native,
     doubleexp,
     gzip_codec,
     huffman,
@@ -405,8 +406,48 @@ class PolySegCodec(Codec):
         return _dc.replace(stripped, signed_indices=signed)
 
 
+class BloomNativeCodec(Codec):
+    """BloomCPU role (pytorch/deepreduce.py:696-736): the C++ host library
+    (native/deepreduce_native.cc) as a registry codec via pure_callback.
+    Index-mode only — its wire format carries the values in-band (the C++
+    op's own layout), so composing a value codec on top would transmit the
+    values twice. The only route to policy='conflict_sets' (P2), which is
+    native-only in the reference too (policies.hpp)."""
+
+    kind = "index"
+    order_preserving = False
+    fixed_size = False  # live wire length rides the in-band nbytes word
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.meta = bloom_native.BloomNativeMeta.create(
+            k, d, fpr=self.params.get("fpr"),
+            policy=self.params.get("policy", "leftmost"),
+        )
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return bloom_native.encode(sp, dense, self.meta, step=step)
+
+    def decode(self, payload, shape, *, step=0):
+        return bloom_native.decode(payload, self.meta, shape, step=step)
+
+    def index_wire_bits(self, payload):
+        # wire minus the embedded values = header + bit-array
+        return bloom_native.wire_bits(payload, self.meta) - self.value_wire_bits(payload)
+
+    def value_wire_bits(self, payload):
+        return payload.nsel.astype(jnp.float32) * 32
+
+    def strip_for_both(self, payload):
+        raise NotImplementedError(
+            "bloom_native is index-mode only: its C++ wire format already "
+            "carries the values in-band (bloom_filter_compression.cc layout)"
+        )
+
+
 INDEX_CODECS: Dict[str, type] = {
     "bloom": BloomCodec,
+    "bloom_native": BloomNativeCodec,
     "rle": RLECodec,
     "integer": IntegerCodec,
     "huffman": HuffmanCodec,

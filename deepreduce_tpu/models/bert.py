@@ -111,6 +111,9 @@ class BertEncoder(nn.Module):
     attention: str = "dense"  # dense | ring | ulysses
     seq_axis: Optional[str] = None  # sequence-sharded mesh axis (shard_map)
     causal: bool = False
+    remat: bool = False  # rematerialize each layer's activations on the
+    # backward pass — the jax.checkpoint HBM-for-FLOPs trade; makes
+    # activation memory O(1) in depth for long-context runs
 
     @nn.compact
     def __call__(self, tokens):  # [batch, chunk] int32 -> MLM logits
@@ -124,8 +127,9 @@ class BertEncoder(nn.Module):
         )
         x = x + pos[None, :, :]
         x = nn.LayerNorm(dtype=self.dtype)(x)
-        for _ in range(self.layers):
-            x = TransformerLayer(
+        layer_cls = nn.remat(TransformerLayer) if self.remat else TransformerLayer
+        for i in range(self.layers):
+            x = layer_cls(
                 self.hidden,
                 self.heads,
                 self.mlp_dim,
@@ -133,6 +137,10 @@ class BertEncoder(nn.Module):
                 attention=self.attention,
                 seq_axis=self.seq_axis,
                 causal=self.causal,
+                # explicit name: nn.remat's auto-name prefix would otherwise
+                # change the param tree, breaking checkpoint transfer
+                # between remat settings
+                name=f"TransformerLayer_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="mlm")(x)

@@ -94,6 +94,12 @@ class TensorCodec:
         else:
             self.k = sparse.num_slots(self.d, cfg.compress_ratio)
 
+        if cfg.deepreduce == "both" and cfg.index == "bloom_native":
+            raise ValueError(
+                "bloom_native is index-mode only: its C++ wire format carries "
+                "values in-band, so a value codec on top would transmit them "
+                "twice — use index='bloom' for 'both' mode"
+            )
         params = cfg.codec_params()
         self.idx_codec = None
         self.val_codec = None

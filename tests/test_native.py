@@ -120,3 +120,52 @@ def test_varint_round_trip():
     enc = native.varint_encode(idx)
     np.testing.assert_array_equal(native.varint_decode(enc, 1000), idx)
     assert len(enc) < 4 * 1000  # beats raw despite 28-bit universe
+
+
+def test_bloom_native_registry_codec_round_trip():
+    """BloomCPU role: the C++ host library as a registry codec under
+    pure_callback — incl. conflict_sets, the native-only P2 policy."""
+    import jax
+
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    d, ratio = 4096, 0.05
+    rng = np.random.default_rng(0)
+    g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    for policy in ("leftmost", "p0", "conflict_sets"):
+        cfg = DeepReduceConfig(
+            deepreduce="index", index="bloom_native", policy=policy,
+            compress_ratio=ratio, fpr=0.01, min_compress_size=100, memory="none",
+        )
+        codec = TensorCodec((d,), cfg, name="t")
+        enc = jax.jit(lambda t, s: codec.encode(t, step=s))
+        dec = jax.jit(lambda p, s: codec.decode(p, step=s))
+        payload = enc(g, jnp.asarray(3))
+        out = np.asarray(dec(payload, jnp.asarray(3)))
+        k = int(d * ratio)
+        top = np.argsort(-np.abs(np.asarray(g)))[:k]
+        hit = np.isin(top, np.nonzero(out)[0]).mean()
+        # only p0 (all positives) guarantees no false negatives; leftmost
+        # can displace up to ~fpr*d of the k slots (~40 of 204 here), and
+        # conflict_sets draws one random member per set so a true index can
+        # lose to an FP sharing its buckets — the reference accepts both
+        # (its get_policy_errors diagnostic exists for exactly this)
+        floor = {"p0": 0.99, "conflict_sets": 0.9, "leftmost": 0.8}[policy]
+        assert hit > floor, (policy, hit)
+        nz = np.nonzero(out)[0]
+        np.testing.assert_allclose(out[nz], np.asarray(g)[nz], rtol=1e-6)
+        stats = codec.wire_stats(payload)
+        assert 0 < float(stats.rel_volume()) < 1.0
+
+
+def test_bloom_native_rejected_in_both_mode():
+    import pytest as _pytest
+
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    cfg = DeepReduceConfig(deepreduce="both", index="bloom_native", value="qsgd",
+                           min_compress_size=100)
+    with _pytest.raises(ValueError, match="index-mode only"):
+        TensorCodec((4096,), cfg, name="t")

@@ -158,3 +158,30 @@ def test_factor_devices_and_make_mesh():
     assert np.prod(list(sizes.values())) == 8
     mesh = make_mesh({"data": 2, "seq": 2})
     assert mesh.shape == {"data": 2, "seq": 2}
+
+
+def test_bert_remat_matches_no_remat():
+    """jax.checkpoint (nn.remat) must change memory, not math: gradients with
+    and without rematerialization agree."""
+    import optax
+
+    from deepreduce_tpu.models import BertEncoder
+
+    kw = dict(vocab_size=32, hidden=16, layers=2, heads=4, mlp_dim=32, max_len=16)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 32, (2, 16)), jnp.int32)
+
+    def grads_for(remat):
+        model = BertEncoder(remat=remat, **kw)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+        def loss(p):
+            logits = model.apply({"params": p}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tokens
+            ).mean()
+
+        return jax.grad(loss)(params)
+
+    g0, g1 = grads_for(False), grads_for(True)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)

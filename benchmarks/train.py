@@ -188,11 +188,18 @@ def run(args) -> dict:
     key = jax.random.PRNGKey(args.seed + 1)
     losses = []
     profiling = False
+    profile_dir = args.profile_dir
+    if profile_dir and args.num_steps < 3:
+        print(
+            f"WARNING: --profile_dir needs num_steps >= 3 to skip the compile "
+            f"step (got {args.num_steps}); profiling disabled", flush=True,
+        )
+        profile_dir = None
     t0 = time.perf_counter()
     try:
         for step in range(args.num_steps):
-            if args.profile_dir and step == min(2, args.num_steps - 1) and not profiling:
-                jax.profiler.start_trace(args.profile_dir)  # skip compile steps
+            if profile_dir and step == 2 and not profiling:
+                jax.profiler.start_trace(profile_dir)  # skip compile steps
                 profiling = True
             batch = make_batch(kind, spec, classes, args.batch_size, rng, model=model)
             state, loss, wire = trainer.step(state, batch, jax.random.fold_in(key, step))
@@ -256,7 +263,16 @@ def main():
                     help="write a jax.profiler trace of the steady-state steps "
                          "(the reference's --log_time timing role, but a real "
                          "XLA trace instead of wall-clock prints)")
-    run(ap.parse_args())
+    ap.add_argument("--platform", type=str, default="",
+                    help="pin the JAX platform (e.g. 'cpu' for the 8-device "
+                         "virtual mesh). Needed because env vars alone don't "
+                         "override the ambient TPU tunnel's jax.config.")
+    args = ap.parse_args()
+    if args.platform:
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform(args.platform, device_count=max(2, args.num_workers))
+    run(args)
 
 
 if __name__ == "__main__":

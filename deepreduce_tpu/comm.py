@@ -63,14 +63,18 @@ class GradientExchanger:
         # all_to_all reshape needs a static worker count)
         self.num_workers = num_workers
         if cfg.communicator == "qar" and (
-            cfg.deepreduce is not None or cfg.compressor not in ("none",)
+            cfg.deepreduce is not None
+            or cfg.compressor not in ("none",)
+            or cfg.memory == "residual"
         ):
             raise ValueError(
                 "communicator='qar' quantizes the DENSE gradient inside the "
-                "collective and never runs the sparsifier or codecs; "
+                "collective and never runs the sparsifier, codecs, or "
+                "error-feedback (its quantization is unbiased); "
                 f"compressor={cfg.compressor!r} / deepreduce={cfg.deepreduce!r} "
-                "would be silently ignored — use compressor='none', "
-                "deepreduce=None (or a different communicator)"
+                f"/ memory={cfg.memory!r} would be silently ignored — use "
+                "compressor='none', deepreduce=None, memory='none' (or a "
+                "different communicator)"
             )
         leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
         self.names = [_leaf_name(path) for path, _ in leaves]
@@ -82,9 +86,8 @@ class GradientExchanger:
     # ------------------------------------------------------------------ #
 
     def init_state(self, grads_like: Any) -> Any:
-        # qar never reads residuals (its quantization is unbiased) — don't
-        # allocate a full-model zero pytree per worker just to carry it
-        if self.cfg.memory == "residual" and self.cfg.communicator != "qar":
+        # qar + residual is rejected at construction, so no guard needed here
+        if self.cfg.memory == "residual":
             return memory.init(grads_like)
         return None
 
@@ -208,7 +211,14 @@ class GradientExchanger:
         # one payload (int8 levels + f32 norms) per phase-equivalent dense
         # transmission: rel_volume = payload_bits / dense_bits, the same
         # convention the allreduce branch uses (the ring's (W-1)/W factor is
-        # identical for both sides of the ratio and cancels)
+        # identical for both sides of the ratio and cancels).
+        # NOTE: this is a *ratio vs the dense-allreduce baseline*, which is
+        # the comparable quantity across communicators; `payload_bytes()`
+        # reports *absolute per-worker wire bytes* and therefore keeps the
+        # explicit 2*(W-1)/W two-phase factor. Do not compare the qar
+        # rel_volume against allgather-path payload_bytes directly — use
+        # rel_volume for cross-config comparisons (both normalize against
+        # their own dense baseline) and payload_bytes for wire sizing.
         payload_bits = n * 8 + (n // cfg.bucket_size) * 32
         stats = WireStats(
             index_bits=jnp.zeros(()),

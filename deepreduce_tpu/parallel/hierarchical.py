@@ -64,7 +64,15 @@ def make_hybrid_mesh(n_slices: int, per_slice: int,
 
 class HierarchicalExchanger:
     """ICI-dense + DCN-compressed exchange. Same call contract as
-    `GradientExchanger.exchange`, for use inside shard_map over BOTH axes."""
+    `GradientExchanger.exchange`, for use inside shard_map over BOTH axes.
+
+    Correctness contract: every ICI replica within a slice must run the
+    *identical* stochastic encode, otherwise model replicas silently
+    desynchronize under stochastic codecs. This class enforces the
+    contract by construction — `exchange` replaces each replica's key
+    with ICI-replica 0's key (one tiny all_gather over the ici axis), so
+    a caller that accidentally folds the ici position into the key still
+    gets bit-identical encodes across the slice."""
 
     def __init__(self, grads_like: Any, cfg: DeepReduceConfig, *,
                  dcn_axis: str = "dcn", ici_axis: str = "ici",
@@ -90,8 +98,16 @@ class HierarchicalExchanger:
         slice_mean = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, self.ici_axis) / n_ici, grads
         )
-        # key must NOT be folded by ici position: every ICI replica of a DCN
-        # group must run the identical stochastic encode
+        # enforce the class contract: every ICI replica of a DCN group runs
+        # the identical stochastic encode. Broadcast replica 0's key over
+        # the ici axis (identity when the caller already passed a shared
+        # key; repairs an accidentally position-folded key).
+        if key is not None:
+            if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):  # typed key
+                kdata = jax.lax.all_gather(jax.random.key_data(key), self.ici_axis)[0]
+                key = jax.random.wrap_key_data(kdata, impl=jax.random.key_impl(key))
+            else:  # raw uint32 PRNGKey array
+                key = jax.lax.all_gather(key, self.ici_axis)[0]
         return self.exchanger.exchange(slice_mean, state, step=step, key=key)
 
     def payload_bytes(self, grads_like: Any) -> int:

@@ -179,8 +179,30 @@ def none_sparsifier(tensor: jax.Array) -> SparseGrad:
     )
 
 
+def stable_name_hash(name: str) -> int:
+    """PYTHONHASHSEED-independent 32-bit hash of a tensor name.
+
+    Murmur3 ``fmix32`` finalizer chained over the UTF-8 bytes — the same
+    mixer the bloom codec uses (codecs/bloom.py:56), so every process on
+    every host derives the identical value for the same name. Python's
+    built-in ``hash(str)`` is salted per process and would desynchronize
+    the deterministic-selection contract multi-worker codecs rely on
+    (reference: bloom_filter_compression.cc:217-218 — all workers must
+    make the same pseudo-random choices)."""
+    h = 0x9747B28C
+    for b in name.encode("utf-8"):
+        h = (h ^ b) & 0xFFFFFFFF
+        h ^= h >> 16
+        h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+        h ^= h >> 13
+        h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+        h ^= h >> 16
+    return h
+
+
 def per_tensor_key(base_key: jax.Array, name: str, step: jax.Array) -> jax.Array:
     """Per-tensor per-step PRNG key — the role of the reference's
-    ``hash(tensor_name) + global_step`` seed (tensorflow/deepreduce.py:293)."""
-    name_hash = jnp.uint32(abs(hash(name)) % (2**31))
+    ``hash(tensor_name) + global_step`` seed (tensorflow/deepreduce.py:293),
+    made stable across processes via :func:`stable_name_hash`."""
+    name_hash = jnp.uint32(stable_name_hash(name))
     return jax.random.fold_in(jax.random.fold_in(base_key, name_hash), step)

@@ -69,7 +69,13 @@ class Run:
             step = self._step
         self._step = step + 1
         rec = {"step": int(step), "ts": time.time()}
-        rec.update(_jsonable(metrics))
+        # user metrics must not clobber the record's own step/ts keys —
+        # history() keys on them; rename collisions instead of dropping data
+        user = {
+            (f"metric.{k}" if k in ("step", "ts") else k): v
+            for k, v in _jsonable(metrics).items()
+        }
+        rec.update(user)
         self._metrics.write(json.dumps(rec) + "\n")
         self._metrics.flush()
 

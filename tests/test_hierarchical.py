@@ -98,3 +98,42 @@ def test_payload_bytes_counts_dcn_only():
     hx = HierarchicalExchanger({"w": jnp.zeros((D,))}, cfg)
     nbytes = hx.payload_bytes({"w": jnp.zeros((D,))})
     assert 0 < nbytes < D * 4  # compressed payload smaller than the dense tensor
+
+
+@pytest.mark.parametrize("key_style", ["raw", "typed"])
+def test_folded_key_repaired_across_ici_replicas(key_style):
+    """The class contract is enforced by construction: even a caller that
+    (wrongly) folds the ici position into the key gets bit-identical
+    encodes across ICI replicas — replica 0's key is broadcast. Covers
+    both raw uint32 PRNGKey arrays and new-style typed keys."""
+    cfg = DeepReduceConfig(
+        compressor="topk", compress_ratio=0.25, deepreduce="value",
+        value="qsgd",  # stochastic: desync would show immediately
+        memory="none", min_compress_size=64,
+    )
+    mesh = make_hybrid_mesh(N_SLICES, PER_SLICE)
+    hx = HierarchicalExchanger({"w": jnp.zeros((D,))}, cfg)
+    state0 = hx.init_state({"w": jnp.zeros((D,))})
+
+    def spmd(g):
+        g = g.reshape(D)
+        base = jax.random.PRNGKey(7) if key_style == "raw" else jax.random.key(7)
+        bad_key = jax.random.fold_in(  # violates the contract on purpose
+            base, jax.lax.axis_index("ici")
+        )
+        agg, _, _ = hx.exchange(
+            {"w": g}, state0, step=jnp.zeros((), jnp.int32), key=bad_key
+        )
+        return agg["w"]
+
+    fn = jax.jit(
+        shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(("dcn", "ici")),),
+            out_specs=P(("dcn", "ici")),
+            check_rep=False,
+        )
+    )
+    out = np.asarray(fn(_grads())).reshape(N_SLICES * PER_SLICE, D)
+    for row in out[1:]:
+        np.testing.assert_array_equal(row, out[0])

@@ -134,15 +134,22 @@ def test_qar_quantum_num_over_int8_rejected():
 def test_qar_no_residual_state_and_wire_bytes():
     from deepreduce_tpu.comm import GradientExchanger
 
-    cfg = DeepReduceConfig(communicator="qar", memory="residual",
+    cfg = DeepReduceConfig(communicator="qar", memory="none",
                            compressor="none", deepreduce=None)
     grads = {"w": jnp.zeros((D,))}
     ex = GradientExchanger(grads, cfg, num_workers=W)
     assert ex.init_state(grads) is None  # unbiased path carries no residual
-    # a config naming a sparsifier/codec that qar would silently ignore is
-    # rejected at construction
+    # any config naming a sparsifier/codec/error-feedback that qar would
+    # silently ignore is rejected at construction (consistently)
     with pytest.raises(ValueError, match="qar"):
         GradientExchanger(grads, DeepReduceConfig(communicator="qar"), num_workers=W)
+    with pytest.raises(ValueError, match="memory"):
+        GradientExchanger(
+            grads,
+            DeepReduceConfig(communicator="qar", memory="residual",
+                             compressor="none", deepreduce=None),
+            num_workers=W,
+        )
     n = qar.pad_len(D, W, 512)
     want = int(qar.wire_bits_per_worker(D, W, 512) // 8)
     assert ex.payload_bytes(grads) == want

@@ -1,5 +1,7 @@
 """Sparsifier and SparseGrad tests (vs numpy oracles)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -85,3 +87,39 @@ def test_sparsifiers_jit_stable():
     sp = f(g)
     sp2 = f(g * 2)
     assert sp.values.shape == sp2.values.shape
+
+
+def test_stable_name_hash_cross_process():
+    """Per-tensor keys must agree across processes regardless of
+    PYTHONHASHSEED (the multi-host determinism contract,
+    bloom_filter_compression.cc:217-218). Python's hash(str) is salted;
+    stable_name_hash must not be."""
+    import subprocess
+    import sys
+
+    prog = (
+        "from deepreduce_tpu.sparse import stable_name_hash;"
+        "print(stable_name_hash('resnet/conv1/kernel'), stable_name_hash(''))"
+    )
+    outs = set()
+    for seed in ("0", "1", "31337"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", prog], capture_output=True, text=True,
+            env=env, check=True, cwd=os.path.dirname(os.path.dirname(__file__)),
+        ).stdout.strip()
+        outs.add(out)
+    assert len(outs) == 1, f"hash varies across processes: {outs}"
+    # and matches this process too
+    h1, h2 = outs.pop().split()
+    assert int(h1) == sparse.stable_name_hash("resnet/conv1/kernel")
+    assert int(h2) == sparse.stable_name_hash("")
+
+
+def test_per_tensor_key_distinct():
+    base = jax.random.PRNGKey(0)
+    k1 = sparse.per_tensor_key(base, "a/kernel", jnp.asarray(0))
+    k2 = sparse.per_tensor_key(base, "a/bias", jnp.asarray(0))
+    k3 = sparse.per_tensor_key(base, "a/kernel", jnp.asarray(1))
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    assert not np.array_equal(np.asarray(k1), np.asarray(k3))

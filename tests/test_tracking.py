@@ -52,3 +52,15 @@ def test_auto_step_and_missing_run(tmp_path):
     assert tracking.runs(str(tmp_path / "nope")) == []
     assert tracking.summary(root, name) == {}  # wrong-name guard below
     assert tracking.summary(root, "missing") == {}
+
+
+def test_user_metric_named_step_or_ts_does_not_clobber(tmp_path):
+    root = str(tmp_path / "t")
+    run = tracking.Run(root, name="clash")
+    run.log({"step": 999, "ts": -1.0, "loss": 0.5}, step=3)
+    run.finish()
+    h = list(tracking.history(root, "clash"))[0]
+    assert h["step"] == 3  # record's own step wins
+    assert h["ts"] > 0  # record's own timestamp wins
+    assert h["metric.step"] == 999 and h["metric.ts"] == -1.0
+    assert h["loss"] == 0.5

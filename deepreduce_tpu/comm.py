@@ -27,10 +27,11 @@ shard_map'ped train step.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepreduce_tpu import memory
 from deepreduce_tpu.config import DeepReduceConfig
@@ -41,6 +42,59 @@ from deepreduce_tpu.wrappers import TensorCodec
 
 def _leaf_name(path) -> str:
     return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+class PayloadLayout:
+    """Static byte layout of one tensor's payload inside the fused buffer.
+
+    Payload pytrees have static structure and leaf shapes (that is the
+    whole point of the static-budget codec design), so the flattening is
+    computed once from abstract shapes and the packing is pure slicing —
+    no per-step host work, no dynamic shapes for XLA."""
+
+    def __init__(self, payload_sds: Any):
+        leaves, self.treedef = jax.tree_util.tree_flatten(payload_sds)
+        self.specs: List[Tuple[Tuple[int, ...], Any]] = [
+            (tuple(int(s) for s in l.shape), jnp.dtype(l.dtype)) for l in leaves
+        ]
+        self.leaf_bytes = [
+            int(np.prod(s, dtype=np.int64)) * dt.itemsize for s, dt in self.specs
+        ]
+        self.nbytes = int(sum(self.leaf_bytes))
+
+    def pack(self, payload: Any) -> jax.Array:
+        """payload pytree -> uint8[nbytes] (bitcast, zero-copy in XLA)."""
+        leaves = jax.tree_util.tree_leaves(payload)
+        segs = []
+        for leaf, (shape, dt) in zip(leaves, self.specs):
+            x = leaf.reshape(-1)
+            if dt == jnp.bool_:
+                x = x.astype(jnp.uint8)
+            elif dt.itemsize > 1:
+                x = jax.lax.bitcast_convert_type(x, jnp.uint8).reshape(-1)
+            else:
+                x = jax.lax.bitcast_convert_type(x, jnp.uint8)
+            segs.append(x)
+        if not segs:
+            return jnp.zeros((0,), jnp.uint8)
+        return jnp.concatenate(segs)
+
+    def unpack(self, buf: jax.Array) -> Any:
+        """uint8[nbytes] -> payload pytree (inverse of pack)."""
+        leaves = []
+        off = 0
+        for (shape, dt), nb in zip(self.specs, self.leaf_bytes):
+            seg = buf[off : off + nb]  # static offsets: pure XLA slices
+            n = int(np.prod(shape, dtype=np.int64))
+            if dt == jnp.bool_:
+                leaf = seg.astype(jnp.bool_)
+            elif dt.itemsize > 1:
+                leaf = jax.lax.bitcast_convert_type(seg.reshape(n, dt.itemsize), dt)
+            else:
+                leaf = jax.lax.bitcast_convert_type(seg, dt)
+            leaves.append(leaf.reshape(shape))
+            off += nb
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
 
 class GradientExchanger:
@@ -82,6 +136,20 @@ class GradientExchanger:
             name: TensorCodec(leaf.shape, cfg, name=name)
             for name, (path, leaf) in zip(self.names, leaves)
         }
+        self._grad_dtypes = {
+            name: jnp.dtype(leaf.dtype) for name, (path, leaf) in zip(self.names, leaves)
+        }
+        self._layouts: Optional[Dict[str, PayloadLayout]] = None
+        if cfg.fused and cfg.communicator == "allgather":
+            self._layouts = {}
+            for name in self.names:
+                codec = self.codecs[name]
+                g_sds = jax.ShapeDtypeStruct(codec.shape, self._grad_dtypes[name])
+                payload_sds = jax.eval_shape(
+                    lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)),
+                    g_sds,
+                )
+                self._layouts[name] = PayloadLayout(payload_sds)
 
     # ------------------------------------------------------------------ #
 
@@ -141,37 +209,111 @@ class GradientExchanger:
 
         flat_grads = dict(zip(self.names, jax.tree_util.tree_leaves(compensated)))
 
-        agg_leaves = {}
-        own_leaves = {}
+        payloads = {}
         stats_per = {}
         for name in self.names:
-            codec = self.codecs[name]
-            g = flat_grads[name]
-            payload = codec.encode(g, step=step, key=keys[name])
-            own = codec.decode(payload, step=step)
-            own_leaves[name] = own
-            stats_per[name] = codec.wire_stats(payload)
+            payloads[name] = self.codecs[name].encode(
+                flat_grads[name], step=step, key=keys[name]
+            )
+            stats_per[name] = self.codecs[name].wire_stats(payloads[name])
 
+        if self._layouts is not None:
+            agg_leaves, own_leaves = self._exchange_fused(
+                payloads, num_workers, step, need_own=state is not None
+            )
+        else:
+            agg_leaves, own_leaves = self._exchange_per_tensor(
+                payloads, num_workers, step, need_own=state is not None
+            )
+
+        # both paths aggregate/decode in f32; hand leaves back in the runtime
+        # gradient dtype so residual state and optimizer updates keep their
+        # dtype across steps (bf16 grads stay bf16)
+        agg = jax.tree_util.tree_unflatten(
+            self.treedef,
+            [agg_leaves[n].astype(flat_grads[n].dtype) for n in self.names],
+        )
+        new_state = state
+        if state is not None:
+            own = jax.tree_util.tree_unflatten(
+                self.treedef,
+                [own_leaves[n].astype(flat_grads[n].dtype) for n in self.names],
+            )
+            new_state = memory.update(compensated, own)
+        return agg, new_state, combine(stats_per)
+
+    def _exchange_per_tensor(
+        self, payloads, num_workers, step, *, need_own: bool
+    ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+        """The reference's shape: one all_gather per gradient tensor
+        (pytorch/deepreduce.py:54-61), sequential worker decode. Returns
+        f32 leaves; `exchange` casts back to the runtime gradient dtype."""
+        agg_leaves, own_leaves = {}, {}
+        for name in self.names:
+            codec = self.codecs[name]
+            payload = payloads[name]
+            if need_own:
+                own_leaves[name] = codec.decode(payload, step=step).astype(
+                    jnp.float32
+                )
             gathered = jax.lax.all_gather(payload, self.axis_name)  # leading axis W
 
             def body(w, acc, _gathered=gathered, _codec=codec):
                 p_w = jax.tree_util.tree_map(lambda x: x[w], _gathered)
                 return acc + _codec.decode(p_w, step=step)
 
-            acc0 = jnp.zeros(codec.shape, g.dtype)
+            acc0 = jnp.zeros(codec.shape, jnp.float32)
             total = jax.lax.fori_loop(0, num_workers, body, acc0)
             agg_leaves[name] = total / num_workers
+        return agg_leaves, own_leaves
 
-        agg = jax.tree_util.tree_unflatten(
-            self.treedef, [agg_leaves[n] for n in self.names]
+    def _exchange_fused(
+        self, payloads, num_workers, step, *, need_own: bool
+    ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+        """TPU-native shape: every tensor's payload bitcast into ONE uint8
+        buffer, ONE all_gather for the whole step (ICI sees a single large
+        transfer instead of ~T latency-bound small ones), then a single
+        fori_loop over workers whose body decodes all tensors. The own-
+        payload decode (for residual error-feedback) is folded into the
+        same loop with a select at w == my_index, so the decode program is
+        traced once, not twice."""
+        layouts = self._layouts
+        widx = jax.lax.axis_index(self.axis_name)
+        buf = jnp.concatenate([layouts[n].pack(payloads[n]) for n in self.names])
+        gathered = jax.lax.all_gather(buf, self.axis_name)  # [W, B]
+
+        offsets = {}
+        off = 0
+        for name in self.names:
+            offsets[name] = off
+            off += layouts[name].nbytes
+
+        acc0 = tuple(
+            jnp.zeros(self.codecs[n].shape, jnp.float32) for n in self.names
         )
-        new_state = state
-        if state is not None:
-            own = jax.tree_util.tree_unflatten(
-                self.treedef, [own_leaves[n] for n in self.names]
-            )
-            new_state = memory.update(compensated, own)
-        return agg, new_state, combine(stats_per)
+        own0 = (
+            tuple(jnp.zeros(self.codecs[n].shape, jnp.float32) for n in self.names)
+            if need_own
+            else ()
+        )
+
+        def body(w, carry):
+            acc, own = carry
+            row = jax.lax.dynamic_index_in_dim(gathered, w, keepdims=False)
+            new_acc, new_own = [], []
+            for i, name in enumerate(self.names):
+                lo = offsets[name]
+                p_w = layouts[name].unpack(row[lo : lo + layouts[name].nbytes])
+                dec = self.codecs[name].decode(p_w, step=step).astype(jnp.float32)
+                new_acc.append(acc[i] + dec)
+                if need_own:
+                    new_own.append(jnp.where(w == widx, dec, own[i]))
+            return tuple(new_acc), tuple(new_own)
+
+        total, own_fin = jax.lax.fori_loop(0, num_workers, body, (acc0, own0))
+        agg_leaves = {name: t / num_workers for name, t in zip(self.names, total)}
+        own_leaves = dict(zip(self.names, own_fin)) if need_own else {}
+        return agg_leaves, own_leaves
 
     def _exchange_qar(
         self, grads: Any, state: Any, *, step: jax.Array, key: Optional[jax.Array]

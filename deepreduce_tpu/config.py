@@ -44,6 +44,13 @@ class DeepReduceConfig:
     sort: bool = False
     seed: int = 0
     use_pallas: bool = False  # pallas TPU kernels where applicable (QSGD PRNG)
+    # fuse the whole pytree's payloads into ONE uint8 buffer per step and
+    # run a single all_gather + one worker-decode loop, instead of one
+    # collective per tensor (ResNet-50 would otherwise issue ~160
+    # latency-bound collectives per step). False = per-tensor collectives
+    # (the reference's shape, one allgather per hook fire,
+    # pytorch/deepreduce.py:54-61).
+    fused: bool = True
     # small-tensor bypass (pytorch/deepreduce.py:68)
     min_compress_size: int = 1000
     # per-layer whitelist: regex on the tensor's pytree path; non-matching

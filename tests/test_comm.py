@@ -120,3 +120,114 @@ def test_payload_bytes_static_accounting():
     ex = GradientExchanger(g, cfg)
     nbytes = ex.payload_bytes(jnp.zeros((100000,), jnp.float32))
     assert 0 < nbytes < 100000 * 4  # well under dense
+
+
+@pytest.mark.parametrize(
+    "codec_cfg",
+    [
+        dict(deepreduce=None, compress_ratio=0.05),
+        dict(deepreduce="index", index="bloom", compress_ratio=0.02, fpr=0.01),
+        dict(deepreduce="both", index="integer", value="qsgd", policy="p0",
+             compress_ratio=0.05),
+        dict(deepreduce="value", value="polyfit", compress_ratio=0.05),
+    ],
+    ids=["topr", "bloom-index", "integer-qsgd-both", "polyfit-value"],
+)
+def test_fused_matches_per_tensor(codec_cfg):
+    """The fused one-buffer exchange is bit-identical to the reference-shaped
+    per-tensor exchange: same payload bytes cross the wire, same decode."""
+    mesh = _mesh()
+    grads_w = _worker_grads(4, d=4096, seed=9)
+    base = dict(memory="residual", min_compress_size=100, **codec_cfg)
+    agg_f, res_f, vol_f, _ = _run_exchange(
+        DeepReduceConfig(fused=True, **base), grads_w, mesh
+    )
+    agg_u, res_u, vol_u, _ = _run_exchange(
+        DeepReduceConfig(fused=False, **base), grads_w, mesh
+    )
+    np.testing.assert_array_equal(agg_f, agg_u)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(res_f)[0]),
+        np.asarray(jax.tree_util.tree_leaves(res_u)[0]),
+    )
+    assert vol_f == pytest.approx(vol_u)
+
+
+def test_fused_multi_tensor_pytree_matches_oracle():
+    """Fused path with a multi-tensor pytree (mixed shapes incl. a small
+    bypassed tensor): aggregate equals the per-worker top-k scatter mean."""
+    mesh = _mesh()
+    rng = np.random.default_rng(11)
+    shapes = {"w1": (64, 32), "b1": (32,), "w2": (2048,)}
+    grads = {
+        n: rng.normal(size=(4,) + s).astype(np.float32) for n, s in shapes.items()
+    }
+    cfg = DeepReduceConfig(
+        deepreduce=None, compress_ratio=0.25, memory="none", min_compress_size=100
+    )
+    like = {n: jax.ShapeDtypeStruct(s, jnp.float32) for n, s in shapes.items()}
+    ex = GradientExchanger(like, cfg)
+
+    def spmd(g):
+        g = jax.tree_util.tree_map(lambda x: x[0], g)
+        agg, _, stats = ex.exchange(g, None, step=jnp.zeros((), jnp.int32))
+        return jax.tree_util.tree_map(lambda x: x[None], agg), stats.rel_volume()
+
+    fn = shard_map(
+        spmd, mesh=mesh,
+        in_specs=({n: P("data") for n in shapes},),
+        out_specs=({n: P("data") for n in shapes}, P()),
+        check_rep=False,
+    )
+    agg, vol = jax.jit(fn)(jax.tree_util.tree_map(jnp.asarray, grads))
+    for n, s in shapes.items():
+        d = int(np.prod(s))
+        flat = grads[n].reshape(4, d)
+        # deepreduce=None: every tensor (incl. the codec-bypassed small one)
+        # is top-k sparsified, so the oracle is the same for all
+        k = max(1, int(d * cfg.compress_ratio))
+        want = np.zeros(d, np.float32)
+        for w in range(4):
+            idx = np.argsort(-np.abs(flat[w]))[:k]
+            scat = np.zeros(d, np.float32)
+            scat[idx] = flat[w][idx]
+            want += scat / 4
+        got = np.asarray(agg[n]).reshape(4, d)
+        for w in range(4):
+            np.testing.assert_allclose(got[w], want, rtol=1e-5, atol=1e-6)
+    assert 0 < float(vol) < 1.0
+
+
+@pytest.mark.parametrize("fused", [True, False], ids=["fused", "per-tensor"])
+def test_bf16_grads_keep_dtype_through_exchange(fused):
+    """bf16 gradients: aggregate and residual state come back bf16 on both
+    paths, so jitted train steps don't retrace (and scan carries don't
+    change type) after the first step."""
+    mesh = _mesh()
+    rng = np.random.default_rng(21)
+    grads_w = rng.normal(size=(4, 4096)).astype(np.float32)
+    cfg = DeepReduceConfig(
+        fused=fused, deepreduce=None, compress_ratio=0.05, memory="residual",
+        min_compress_size=100,
+    )
+    like = jax.ShapeDtypeStruct((4096,), jnp.bfloat16)
+    ex = GradientExchanger(like, cfg)
+    res0 = ex.init_state(jnp.zeros((4096,), jnp.bfloat16))
+
+    def spmd(g, res):
+        res = jax.tree_util.tree_map(lambda r: r[0], res)
+        agg, new_res, _ = ex.exchange(g[0].astype(jnp.bfloat16), res, step=0)
+        return agg[None], jax.tree_util.tree_map(lambda r: r[None], new_res)
+
+    fn = shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P("data"), P("data")),
+        out_specs=(P("data"), P("data")),
+        check_rep=False,
+    )
+    res0_w = jax.tree_util.tree_map(
+        lambda r: jnp.broadcast_to(r[None], (4,) + r.shape), res0
+    )
+    agg, new_res = jax.jit(fn)(jnp.asarray(grads_w), res0_w)
+    assert agg.dtype == jnp.bfloat16
+    assert jax.tree_util.tree_leaves(new_res)[0].dtype == jnp.bfloat16

@@ -259,16 +259,25 @@ def query_universe(words: jax.Array, meta: BloomMeta) -> jax.Array:
 
 
 def _prefix_select(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Array]:
-    """First `budget` True positions of `mask`, ascending. Implemented as
-    top_k over descending position keys — ~2x faster than the cumsum+scatter
-    compaction on TPU (the scatter is latency-bound). Returns
-    (indices[budget], count)."""
+    """First `budget` True positions of `mask`, ascending — exact stream
+    compaction by rank-scatter: positive j's output slot IS its rank
+    ``cumsum(mask)[j]-1``, so one masked unique-index scatter of the
+    position values builds the list with no d-scale sort. Bit-consistent
+    with `encode`'s rank-addressed value layout and with `decode_dense`'s
+    rank-gather. Dead slots carry index 0 (the SparseGrad padding
+    contract). Returns (indices[budget], count)."""
     d = mask.shape[0]
-    keys = jnp.where(mask, jnp.int32(d) - jnp.arange(d, dtype=jnp.int32), 0)
-    _, idx = jax.lax.top_k(keys, budget)  # largest key = smallest position
-    count = jnp.minimum(jnp.sum(mask.astype(jnp.int32)), budget)
-    live = jnp.arange(budget, dtype=jnp.int32) < count
-    return jnp.where(live, idx, 0).astype(jnp.int32), count
+    cs = jnp.cumsum(mask.astype(jnp.int32))
+    ranks = cs - 1
+    count = jnp.minimum(cs[-1], budget)
+    live = jnp.logical_and(mask, ranks < budget)
+    tgt = jnp.where(live, ranks, budget + jnp.arange(d, dtype=jnp.int32))
+    idx = (
+        jnp.zeros((budget,), jnp.int32)
+        .at[tgt]
+        .set(jnp.arange(d, dtype=jnp.int32), mode="drop", unique_indices=True)
+    )
+    return idx, count
 
 
 def select(
@@ -303,9 +312,32 @@ def encode(
     step: jax.Array = 0,
     seed: int = 0,
 ) -> BloomPayload:
-    """Insert + FP-aware value re-read (pytorch/deepreduce.py:505-533)."""
+    """Insert + FP-aware value re-read (pytorch/deepreduce.py:505-533).
+
+    For the prefix policies the re-read is rank-addressed: positive j's
+    value lands in slot ``rank(j) = cumsum(mask)[j]-1`` (exactly the slot
+    `decode_dense` will read it from) via one masked unique-index scatter —
+    no d-scale sort. `select` remains for the `random` policy."""
     words = insert(sp.indices, sp.nnz, meta)
-    if dense is not None:
+    if dense is not None and meta.policy in ("leftmost", "p0"):
+        flat = dense.reshape(-1)
+        d = flat.shape[0]
+        mask = query_universe(words, meta)
+        cs = jnp.cumsum(mask.astype(jnp.int32))
+        ranks = cs - 1
+        nsel = jnp.minimum(cs[-1], meta.budget)
+        live = jnp.logical_and(mask, ranks < meta.budget)
+        # dead slots get unique out-of-range targets so mode='drop' discards
+        # them without breaking the unique-indices promise
+        tgt = jnp.where(
+            live, ranks, meta.budget + jnp.arange(d, dtype=jnp.int32)
+        )
+        values = (
+            jnp.zeros((meta.budget,), flat.dtype)
+            .at[tgt]
+            .set(jnp.where(live, flat, 0.0), mode="drop", unique_indices=True)
+        )
+    elif dense is not None:
         mask = query_universe(words, meta)
         selected, nsel = select(mask, meta, step=step, seed=seed)
         flat = dense.reshape(-1)
@@ -329,7 +361,9 @@ def decode(
     seed: int = 0,
 ) -> SparseGrad:
     """Query the universe, re-run the policy, pair with transmitted values
-    (pytorch/deepreduce.py:535-555)."""
+    (pytorch/deepreduce.py:535-555). The selection list is exact-rank, so
+    it is bit-consistent with `encode`'s rank-addressed value layout; the
+    wrapper's production path (`decode_dense`) skips the list entirely."""
     mask = query_universe(payload.words, meta)
     selected, nsel = select(mask, meta, step=step, seed=seed)
     nsel = jnp.minimum(nsel, payload.nsel)
@@ -339,6 +373,47 @@ def decode(
         nnz=nsel.astype(jnp.int32),
         shape=shape,
     )
+
+
+def decode_dense(
+    payload: BloomPayload,
+    meta: BloomMeta,
+    shape: Tuple[int, ...],
+    *,
+    step: jax.Array = 0,
+    seed: int = 0,
+    values: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Rank-gather decode straight to the dense tensor — the TPU fast path.
+
+    For the prefix policies (leftmost/p0) the selection is "the first
+    `budget` positives ascending", so a universe index's slot in the value
+    stream IS its rank among positives: ``rank(j) = cumsum(mask)[j] - 1``.
+    Materializing the selection list (a d-scale sort or scatter — the round-1
+    bottleneck) is unnecessary:
+
+        dense[j] = live(j) ? values[rank(j)] : 0
+        live(j)  = mask[j] and rank(j) < nsel
+
+    Three fused memory-bound d-scale passes (hash+query, cumsum, gather from
+    the budget-sized value table) — no sort, no scatter, nothing for XLA to
+    serialize. `values` overrides the payload's value stream ('both' mode
+    passes the value-codec output, already in rank order)."""
+    if meta.policy not in ("leftmost", "p0"):
+        # list-based fallback (random policy): selection order == value-slot
+        # order, so an override table substitutes positionally
+        sp = decode(payload, meta, shape, step=step, seed=seed)
+        if values is not None:
+            sp = dataclasses.replace(sp, values=values)
+        return sp.to_dense()
+    vals = payload.values if values is None else values
+    mask = query_universe(payload.words, meta)
+    ranks = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    nsel = jnp.minimum(payload.nsel, meta.budget)
+    live = jnp.logical_and(mask, ranks < nsel)
+    safe = jnp.clip(ranks, 0, vals.shape[0] - 1)
+    dense = jnp.where(live, vals[safe], jnp.zeros((), vals.dtype))
+    return dense.reshape(shape)
 
 
 def wire_bits(payload: BloomPayload, meta: BloomMeta) -> jax.Array:

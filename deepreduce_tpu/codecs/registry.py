@@ -120,6 +120,13 @@ class BloomCodec(Codec):
     def decode(self, payload, shape, *, step=0):
         return bloom.decode(payload, self.meta, shape, step=step, seed=self.seed)
 
+    def decode_dense(self, payload, shape, *, step=0, values=None):
+        """TPU fast path: rank-gather straight to dense (bloom.decode_dense),
+        skipping the selection-list materialization entirely."""
+        return bloom.decode_dense(
+            payload, self.meta, shape, step=step, seed=self.seed, values=values
+        )
+
     def index_wire_bits(self, payload):
         return jnp.asarray(64.0 + self.meta.m_bits, jnp.float32)
 

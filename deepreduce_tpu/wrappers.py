@@ -186,6 +186,8 @@ class TensorCodec:
         if mode == "value":
             return self.val_codec.decode(payload, self.shape, step=step).to_dense()
         if mode == "index":
+            if hasattr(self.idx_codec, "decode_dense"):
+                return self.idx_codec.decode_dense(payload, self.shape, step=step)
             return self.idx_codec.decode(payload, self.shape, step=step).to_dense()
 
         vk = self.val_codec.k
@@ -198,6 +200,25 @@ class TensorCodec:
         ipay = dataclasses.replace(
             payload.index_payload, values=jnp.zeros((vk,), jnp.float32)
         )
+        if hasattr(self.idx_codec, "decode_dense"):
+            # rank-gather fast path: build the slot-ordered value table (the
+            # inverse of the codec reordering — identity when the mapping was
+            # elided) and let the index codec place it densely, skipping the
+            # selection-list materialization
+            if mapping_arr is None:
+                table = vsp.values
+            else:
+                # vsp.indices is a permutation of arange(vk) by construction,
+                # but defend against codec dead-slot padding: out-of-range
+                # targets drop instead of clipping onto a live slot
+                table = (
+                    jnp.zeros((vk,), vsp.values.dtype)
+                    .at[vsp.indices]
+                    .set(vsp.values, mode="drop")
+                )
+            return self.idx_codec.decode_dense(
+                ipay, self.shape, step=step, values=table
+            )
         isp = self.idx_codec.decode(ipay, self.shape, step=step)  # ascending indices
         # undo both reorderings (:290): vsp.indices maps codec order -> selection slot
         sel = jnp.clip(vsp.indices, 0, vk - 1)

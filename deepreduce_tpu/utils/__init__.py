@@ -17,6 +17,28 @@ from deepreduce_tpu.logging_utils import DumpLogger, policy_errors
 from deepreduce_tpu.metrics import WireStats, combine, payload_device_bytes
 
 
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Persistent XLA compilation cache (default: <repo>/.jax_cache,
+    gitignored). Repeat runs of the driver entry points and benchmarks skip
+    the cold compile of the big spmd programs. Safe no-op on jax versions
+    without the knobs."""
+    import os
+
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
+
 def force_platform(platform: str, device_count: int = 8) -> None:
     """Pin the JAX platform in-process. Env vars alone don't stick under the
     axon TPU tunnel, so anything that needs the virtual CPU mesh (tests,
@@ -44,5 +66,6 @@ __all__ = [
     "WireStats",
     "combine",
     "payload_device_bytes",
+    "enable_compile_cache",
     "force_platform",
 ]

@@ -39,6 +39,19 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         pass
 
 
+def host_device_count_flags(flags: str, device_count: int) -> str:
+    """XLA_FLAGS string with --xla_force_host_platform_device_count set to
+    `device_count`, replacing any existing setting (one shared helper — the
+    flag is consulted once, at CPU-client init)."""
+    kept = [
+        f
+        for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    kept.append(f"--xla_force_host_platform_device_count={device_count}")
+    return " ".join(kept)
+
+
 def force_platform(platform: str, device_count: int = 8) -> None:
     """Pin the JAX platform in-process. Env vars alone don't stick under the
     axon TPU tunnel, so anything that needs the virtual CPU mesh (tests,
@@ -49,9 +62,7 @@ def force_platform(platform: str, device_count: int = 8) -> None:
     os.environ["JAX_PLATFORMS"] = platform
     flags = os.environ.get("XLA_FLAGS", "")
     if platform == "cpu" and "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={device_count}"
-        ).strip()
+        os.environ["XLA_FLAGS"] = host_device_count_flags(flags, device_count)
     import jax
 
     jax.config.update("jax_platforms", platform)

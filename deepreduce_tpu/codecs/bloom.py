@@ -45,7 +45,6 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from deepreduce_tpu.codecs import packing
 from deepreduce_tpu.sparse import SparseGrad
 
 _LN2 = 0.6931471805599453
@@ -81,20 +80,36 @@ _SEED_LANE1 = 0x6A09E667
 _SEED_LANE2 = 0xBB67AE85
 
 
-def blocked_block_and_mask(indices: jax.Array, meta: "BloomMeta") -> Tuple[jax.Array, jax.Array]:
-    """(word index [..], 32-bit in-word mask [..]) for the blocked filter.
-    h bit lanes come from 5-bit fields of one or two mixed words."""
+def lane_mask(indices: jax.Array, num_hash: int) -> jax.Array:
+    """32-bit in-word mask [..] for the blocked filters: h bit lanes from
+    5-bit fields of one or two murmur-mixed words."""
     idx = jnp.asarray(indices, jnp.uint32)
-    n_words = meta.m_bits // 32
-    block = (fmix32(idx ^ jnp.uint32(_SEED_BLOCK)) % jnp.uint32(n_words)).astype(jnp.int32)
     r1 = fmix32(idx ^ jnp.uint32(_SEED_LANE1))
     r2 = fmix32(idx ^ jnp.uint32(_SEED_LANE2))
     mask = jnp.zeros_like(idx)
-    for j in range(meta.num_hash):
+    for j in range(num_hash):
         r = r1 if j < 6 else r2
         lane = (r >> jnp.uint32(5 * (j % 6))) & jnp.uint32(31)
         mask = mask | (jnp.uint32(1) << lane)
-    return block, mask
+    return mask
+
+
+def blocked_block_and_mask(indices: jax.Array, meta: "BloomMeta") -> Tuple[jax.Array, jax.Array]:
+    """(word index [..], 32-bit in-word mask [..]) for the blocked filters.
+
+    Block assignment by mode: ``hash`` mixes the index (classic blocked
+    bloom); ``mod`` uses ``j mod W`` with W odd — arithmetic, so the
+    universe query needs NO gather at all (see `query_universe`), and odd W
+    is coprime to every power-of-2 stride, which spreads the structured
+    index patterns gradients actually produce (consecutive runs, strided
+    embedding rows) sub-Poisson across words."""
+    idx = jnp.asarray(indices, jnp.uint32)
+    n_words = meta.m_bits // 32
+    if meta.blocked == "mod":
+        block = (idx % jnp.uint32(n_words)).astype(jnp.int32)
+    else:
+        block = (fmix32(idx ^ jnp.uint32(_SEED_BLOCK)) % jnp.uint32(n_words)).astype(jnp.int32)
+    return block, lane_mask(idx, meta.num_hash)
 
 
 def bloom_config(k: int, d: int, fpr: Optional[float]) -> Tuple[int, int, float]:
@@ -129,7 +144,9 @@ def _blocked_fpr(k: int, n_words: int, h: int) -> float:
     return total
 
 
-def blocked_bloom_config(k: int, d: int, fpr: Optional[float]) -> Tuple[int, int, float]:
+def blocked_bloom_config(
+    k: int, d: int, fpr: Optional[float], mode: str = "hash"
+) -> Tuple[int, int, float]:
     if fpr is None:
         fpr = 0.1 * k / d
     classic_bits, _, _ = bloom_config(k, d, fpr)
@@ -146,7 +163,10 @@ def blocked_bloom_config(k: int, d: int, fpr: Optional[float]) -> Tuple[int, int
         n_words = int(n_words * 1.3) + 1
     if best is None:
         best = (n_words, 12)
-    return best[0] * 32, best[1], fpr
+    n_words, h = best
+    if mode == "mod":
+        n_words |= 1  # odd: coprime to power-of-2 index strides
+    return n_words * 32, h, fpr
 
 
 def p0_budget(k: int, d: int, fpr: float) -> int:
@@ -161,7 +181,12 @@ def policy_budget(policy: str, k: int, d: int, fpr: float) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class BloomMeta:
-    """Static codec geometry, shared by encode and decode."""
+    """Static codec geometry, shared by encode and decode.
+
+    `blocked`: "" = classic bit-addressed filter (h positions/key);
+    "hash" = register-blocked, block chosen by hash (1 gather/query);
+    "mod" = register-blocked, block = j mod W with W odd (query is a pure
+    broadcast — zero gathers; the measured-fastest TPU variant)."""
 
     d: int
     k: int
@@ -170,7 +195,19 @@ class BloomMeta:
     fpr: float
     policy: str
     budget: int
-    blocked: bool = False
+    blocked: str = ""
+
+    @staticmethod
+    def normalize_blocked(blocked) -> str:
+        """Config values False/True/"hash"/"mod" -> canonical mode string
+        ("" / "mod" / "hash"). True means "the fast one" = mod."""
+        if blocked is True:
+            return "mod"
+        if not blocked:
+            return ""
+        if blocked in ("hash", "mod"):
+            return blocked
+        raise ValueError(f"bloom_blocked must be bool, 'hash' or 'mod'; got {blocked!r}")
 
     @staticmethod
     def create(
@@ -178,15 +215,18 @@ class BloomMeta:
         d: int,
         fpr: Optional[float] = None,
         policy: str = "leftmost",
-        blocked: bool = False,
+        blocked=False,
     ) -> "BloomMeta":
         if policy == "conflict_sets":
             raise NotImplementedError(
                 "conflict_sets (P2) is native-only, as in the reference "
                 "(policies.hpp:43-146); use deepreduce_tpu.native.bloom"
             )
-        cfg_fn = blocked_bloom_config if blocked else bloom_config
-        m_bits, num_hash, fpr_eff = cfg_fn(k, d, fpr)
+        blocked = BloomMeta.normalize_blocked(blocked)
+        if blocked:
+            m_bits, num_hash, fpr_eff = blocked_bloom_config(k, d, fpr, mode=blocked)
+        else:
+            m_bits, num_hash, fpr_eff = bloom_config(k, d, fpr)
         return BloomMeta(
             d=d,
             k=k,
@@ -207,25 +247,54 @@ class BloomPayload:
     nsel: jax.Array  # i32[] — live selected count (p0 count prefix role)
 
 
+def _scatter_or(n_words: int, word_idx: jax.Array, masks: jax.Array) -> jax.Array:
+    """uint32[n_words]: OR-combine `masks` into their target words.
+
+    XLA has no OR-scatter, and a per-bit ``.at[].max`` scatter serializes on
+    collisions (the round-2 encode bottleneck: ~145ms at k=405k on TPU).
+    Instead: k-scale sort by word, segmented OR via associative scan, then
+    ONE unique-index scatter of each segment's end — ~5ms at the same size.
+    """
+    order = jnp.argsort(word_idx)
+    ws = word_idx[order]
+    ms = masks[order]
+
+    def comb(a, b):
+        aw, am = a
+        bw, bm = b
+        return bw, jnp.where(aw == bw, am | bm, bm)
+
+    _, acc = jax.lax.associative_scan(comb, (ws, ms))
+    is_end = jnp.concatenate([ws[1:] != ws[:-1], jnp.ones((1,), bool)])
+    # dead slots park at unique out-of-range targets: mode='drop' discards
+    # them without breaking the unique-indices promise
+    tgt = jnp.where(
+        is_end, ws, n_words + jnp.arange(ws.shape[0], dtype=ws.dtype)
+    )
+    return (
+        jnp.zeros((n_words,), jnp.uint32)
+        .at[tgt]
+        .set(acc, mode="drop", unique_indices=True)
+    )
+
+
 def insert(indices: jax.Array, nnz: jax.Array, meta: BloomMeta) -> jax.Array:
     """Build the packed filter from (possibly padded) indices.
 
     Dead slots are re-pointed at the first index — inserting a duplicate is a
-    no-op under bloom set semantics, which keeps the scatter static-shape.
+    no-op under bloom set semantics, which keeps everything static-shape.
     """
     live = jnp.arange(indices.shape[0], dtype=jnp.int32) < nnz
     idx = jnp.where(live, indices, indices[0])
+    n_words = meta.m_bits // 32
     if meta.blocked:
         block, mask = blocked_block_and_mask(idx, meta)
-        lane = jnp.arange(32, dtype=jnp.uint32)
-        bits_mat = ((mask[:, None] >> lane[None, :]) & jnp.uint32(1)).astype(jnp.uint8)
-        pos = (block[:, None] * 32 + lane[None, :].astype(jnp.int32)).reshape(-1)
-        bits = jnp.zeros((meta.m_bits,), jnp.uint8).at[pos].max(bits_mat.reshape(-1))
-        return packing.pack_bitmap(bits)
+        return _scatter_or(n_words, block, mask)
     seeds = hash_seeds(meta.num_hash)
     pos = hash_positions(idx, seeds, meta.m_bits).reshape(-1)
-    bits = jnp.zeros((meta.m_bits,), jnp.uint8).at[pos].max(jnp.uint8(1))
-    return packing.pack_bitmap(bits)
+    word = pos // 32
+    mask = jnp.uint32(1) << (pos % 32).astype(jnp.uint32)
+    return _scatter_or(n_words, word, mask)
 
 
 def query_universe(words: jax.Array, meta: BloomMeta) -> jax.Array:
@@ -233,6 +302,21 @@ def query_universe(words: jax.Array, meta: BloomMeta) -> jax.Array:
     (pytorch/deepreduce.py:466-477), chunked so the [chunk, h] position block
     stays small regardless of d."""
     d = meta.d
+    if meta.blocked == "mod":
+        # ZERO gathers: block(j) = j mod W, so scanning the universe in
+        # natural order makes the word index cycle 0..W-1 — laying the
+        # universe out as [ceil(d/W), W], each row tests against the whole
+        # word array by broadcast. Pure elementwise + one reshape.
+        n_words = meta.m_bits // 32
+        rows = (d + n_words - 1) // n_words
+        j = (
+            jnp.arange(rows, dtype=jnp.uint32)[:, None] * jnp.uint32(n_words)
+            + jnp.arange(n_words, dtype=jnp.uint32)[None, :]
+        )
+        mask = lane_mask(j, meta.num_hash)
+        hit = (words[None, :] & mask) == mask
+        hit = jnp.logical_and(hit, j < jnp.uint32(d))
+        return hit.reshape(-1)[:d]
     if meta.blocked:
         # ONE gather per index: word + arithmetic in-word mask test
         idx = jnp.arange(d, dtype=jnp.int32)
@@ -258,26 +342,69 @@ def query_universe(words: jax.Array, meta: BloomMeta) -> jax.Array:
     return mask.reshape(-1)[:d]
 
 
-def _prefix_select(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Array]:
-    """First `budget` True positions of `mask`, ascending — exact stream
-    compaction by rank-scatter: positive j's output slot IS its rank
-    ``cumsum(mask)[j]-1``, so one masked unique-index scatter of the
-    position values builds the list with no d-scale sort. Bit-consistent
-    with `encode`'s rank-addressed value layout and with `decode_dense`'s
-    rank-gather. Dead slots carry index 0 (the SparseGrad padding
-    contract). Returns (indices[budget], count)."""
+def _select_bit(word: jax.Array, t: jax.Array) -> jax.Array:
+    """Position of the (t+1)-th set bit of each uint32 `word` — 5-step
+    binary select over popcounts of low halves, fully vectorized."""
+    pos = jnp.zeros_like(t)
+    rem = t
+    for width in (16, 8, 4, 2, 1):
+        low = (word >> pos.astype(jnp.uint32)) & (
+            (jnp.uint32(1) << jnp.uint32(width)) - 1
+        )
+        c = jax.lax.population_count(low).astype(jnp.int32)
+        hi = rem >= c
+        rem = rem - jnp.where(hi, c, 0)
+        pos = pos + jnp.where(hi, width, 0)
+    return pos
+
+
+def _prefix_positions(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Array]:
+    """(positions[budget], count): universe positions of the first `budget`
+    True entries of `mask`, ascending — WITHOUT a d-scale sort or scatter.
+
+    Rank inversion in three cheap moves (the round-3 encode unlock; the
+    round-2 rank-scatter cost ~17ms at d=4M on TPU, this costs ~3ms):
+      1. pack the mask into 32-bit group words; per-group popcounts and
+         their (exclusive) prefix P give every group's first output slot;
+      2. ONE small scatter-add of a marker per group at slot P[g] (parked
+         past `budget` when the group starts beyond it); cumsum of the
+         markers tells each output slot s which group it reads from —
+         g(s) = cumsum[s] - 1, exact even across empty-group runs;
+      3. the in-group bit offset is `_select_bit(word[g], s - P[g])`.
+    Only budget-scale gathers + one G-scale unique-ish scatter-add remain.
+    Dead slots (s >= count) return position clipped into range — callers
+    mask them."""
     d = mask.shape[0]
-    cs = jnp.cumsum(mask.astype(jnp.int32))
-    ranks = cs - 1
-    count = jnp.minimum(cs[-1], budget)
-    live = jnp.logical_and(mask, ranks < budget)
-    tgt = jnp.where(live, ranks, budget + jnp.arange(d, dtype=jnp.int32))
-    idx = (
-        jnp.zeros((budget,), jnp.int32)
-        .at[tgt]
-        .set(jnp.arange(d, dtype=jnp.int32), mode="drop", unique_indices=True)
+    g_count = (d + 31) // 32
+    padded = (
+        jnp.zeros((g_count * 32,), jnp.uint32).at[:d].set(mask.astype(jnp.uint32))
     )
-    return idx, count
+    hw = jnp.sum(
+        padded.reshape(g_count, 32) << jnp.arange(32, dtype=jnp.uint32)[None, :],
+        axis=1,
+    ).astype(jnp.uint32)
+    cnt = jax.lax.population_count(hw).astype(jnp.int32)
+    cs = jnp.cumsum(cnt)
+    p_ex = cs - cnt
+    count = jnp.minimum(cs[-1], budget)
+    markers = (
+        jnp.zeros((budget + 1,), jnp.int32).at[jnp.minimum(p_ex, budget)].add(1)
+    )
+    g_of_s = jnp.clip(jnp.cumsum(markers)[:budget] - 1, 0, g_count - 1)
+    t = jnp.arange(budget, dtype=jnp.int32) - p_ex[g_of_s]
+    b = _select_bit(hw[g_of_s], t)
+    pos = jnp.clip(g_of_s * 32 + b, 0, d - 1)
+    return pos, count
+
+
+def _prefix_select(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Array]:
+    """First `budget` True positions of `mask`, ascending. Bit-consistent
+    with `encode`'s rank-addressed value layout and with `decode_dense`.
+    Dead slots carry index 0 (the SparseGrad padding contract). Returns
+    (indices[budget], count)."""
+    pos, count = _prefix_positions(mask, budget)
+    live = jnp.arange(budget, dtype=jnp.int32) < count
+    return jnp.where(live, pos, 0), count
 
 
 def select(
@@ -314,29 +441,18 @@ def encode(
 ) -> BloomPayload:
     """Insert + FP-aware value re-read (pytorch/deepreduce.py:505-533).
 
-    For the prefix policies the re-read is rank-addressed: positive j's
-    value lands in slot ``rank(j) = cumsum(mask)[j]-1`` (exactly the slot
-    `decode_dense` will read it from) via one masked unique-index scatter —
-    no d-scale sort. `select` remains for the `random` policy."""
+    For the prefix policies the re-read inverts the rank function instead
+    of scattering by it: `_prefix_positions` yields slot s's universe
+    position, so values are ONE budget-scale gather from the dense tensor
+    — no d-scale sort or scatter anywhere in encode. `select` remains for
+    the `random` policy."""
     words = insert(sp.indices, sp.nnz, meta)
     if dense is not None and meta.policy in ("leftmost", "p0"):
         flat = dense.reshape(-1)
-        d = flat.shape[0]
         mask = query_universe(words, meta)
-        cs = jnp.cumsum(mask.astype(jnp.int32))
-        ranks = cs - 1
-        nsel = jnp.minimum(cs[-1], meta.budget)
-        live = jnp.logical_and(mask, ranks < meta.budget)
-        # dead slots get unique out-of-range targets so mode='drop' discards
-        # them without breaking the unique-indices promise
-        tgt = jnp.where(
-            live, ranks, meta.budget + jnp.arange(d, dtype=jnp.int32)
-        )
-        values = (
-            jnp.zeros((meta.budget,), flat.dtype)
-            .at[tgt]
-            .set(jnp.where(live, flat, 0.0), mode="drop", unique_indices=True)
-        )
+        pos, nsel = _prefix_positions(mask, meta.budget)
+        live = jnp.arange(meta.budget, dtype=jnp.int32) < nsel
+        values = jnp.where(live, flat[pos], jnp.zeros((), flat.dtype))
     elif dense is not None:
         mask = query_universe(words, meta)
         selected, nsel = select(mask, meta, step=step, seed=seed)
@@ -384,21 +500,19 @@ def decode_dense(
     seed: int = 0,
     values: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Rank-gather decode straight to the dense tensor — the TPU fast path.
+    """Rank-inversion decode straight to the dense tensor — the TPU fast
+    path.
 
     For the prefix policies (leftmost/p0) the selection is "the first
-    `budget` positives ascending", so a universe index's slot in the value
-    stream IS its rank among positives: ``rank(j) = cumsum(mask)[j] - 1``.
-    Materializing the selection list (a d-scale sort or scatter — the round-1
-    bottleneck) is unnecessary:
+    `budget` positives ascending", so value slot s belongs at universe
+    position `_prefix_positions(mask)[s]`:
 
-        dense[j] = live(j) ? values[rank(j)] : 0
-        live(j)  = mask[j] and rank(j) < nsel
+        dense[pos(s)] = values[s]   for s < nsel
 
-    Three fused memory-bound d-scale passes (hash+query, cumsum, gather from
-    the budget-sized value table) — no sort, no scatter, nothing for XLA to
-    serialize. `values` overrides the payload's value stream ('both' mode
-    passes the value-codec output, already in rank order)."""
+    One budget-scale unique-index scatter instead of the round-2 d-scale
+    rank gather (`dense[j] = vals[cumsum(mask)[j]-1]`, ~20ms at d=4M on
+    TPU; this is ~4ms). `values` overrides the payload's value stream
+    ('both' mode passes the value-codec output, already in rank order)."""
     if meta.policy not in ("leftmost", "p0"):
         # list-based fallback (random policy): selection order == value-slot
         # order, so an override table substitutes positionally
@@ -407,12 +521,28 @@ def decode_dense(
             sp = dataclasses.replace(sp, values=values)
         return sp.to_dense()
     vals = payload.values if values is None else values
+    d = meta.d
+    # tolerate value tables shorter/longer than the budget ('both' mode can
+    # hand in a k-length table while p0's budget exceeds k): pad with zeros
+    # and never read past the table's live length
+    n_v = vals.shape[0]
+    if n_v < meta.budget:
+        vals = jnp.zeros((meta.budget,), vals.dtype).at[:n_v].set(vals)
+    else:
+        vals = vals[: meta.budget]
     mask = query_universe(payload.words, meta)
-    ranks = jnp.cumsum(mask.astype(jnp.int32)) - 1
-    nsel = jnp.minimum(payload.nsel, meta.budget)
-    live = jnp.logical_and(mask, ranks < nsel)
-    safe = jnp.clip(ranks, 0, vals.shape[0] - 1)
-    dense = jnp.where(live, vals[safe], jnp.zeros((), vals.dtype))
+    pos, derived = _prefix_positions(mask, meta.budget)
+    nsel = jnp.minimum(jnp.minimum(payload.nsel, meta.budget), derived)
+    nsel = jnp.minimum(nsel, n_v)
+    live = jnp.arange(meta.budget, dtype=jnp.int32) < nsel
+    # dead slots park at unique out-of-range targets so mode='drop' discards
+    # them without breaking the unique-indices promise
+    tgt = jnp.where(live, pos, d + jnp.arange(meta.budget, dtype=jnp.int32))
+    dense = (
+        jnp.zeros((d,), vals.dtype)
+        .at[tgt]
+        .set(vals, mode="drop", unique_indices=True)
+    )
     return dense.reshape(shape)
 
 

@@ -110,7 +110,7 @@ class BloomCodec(Codec):
             d,
             fpr=self.params.get("fpr"),
             policy=self.params.get("policy", "leftmost"),
-            blocked=bool(self.params.get("bloom_blocked", False)),
+            blocked=self.params.get("bloom_blocked", False),
         )
         self.seed = int(self.params.get("seed", 0))
 

@@ -134,12 +134,13 @@ def test_wire_bits_smaller_than_raw_indices():
 
 
 @pytest.mark.parametrize("fpr", [0.05, 0.01, 0.001])
-def test_blocked_no_false_negatives_and_fpr(fpr):
+@pytest.mark.parametrize("blocked", ["hash", "mod"])
+def test_blocked_no_false_negatives_and_fpr(fpr, blocked):
     rng = np.random.default_rng(10)
     d = 100000
     g = rng.normal(size=d).astype(np.float32)
     sp = sparse.topk(jnp.asarray(g), 0.01)
-    meta = bloom.BloomMeta.create(sp.k, d, fpr=fpr, blocked=True)
+    meta = bloom.BloomMeta.create(sp.k, d, fpr=fpr, blocked=blocked)
     words = bloom.insert(sp.indices, sp.nnz, meta)
     mask = np.asarray(bloom.query_universe(words, meta))
     assert mask[np.asarray(sp.indices)].all()
@@ -149,12 +150,13 @@ def test_blocked_no_false_negatives_and_fpr(fpr):
 
 
 @pytest.mark.parametrize("policy", ["leftmost", "random", "p0"])
-def test_blocked_encode_decode_agree(policy):
+@pytest.mark.parametrize("blocked", ["hash", "mod"])
+def test_blocked_encode_decode_agree(policy, blocked):
     rng = np.random.default_rng(11)
     d = 50000
     g = rng.normal(size=d).astype(np.float32)
     sp = sparse.topk(jnp.asarray(g), 0.01)
-    meta = bloom.BloomMeta.create(sp.k, d, fpr=0.01, policy=policy, blocked=True)
+    meta = bloom.BloomMeta.create(sp.k, d, fpr=0.01, policy=policy, blocked=blocked)
     payload = bloom.encode(sp, jnp.asarray(g), meta, step=9)
     out = bloom.decode(payload, meta, sp.shape, step=9)
     nsel = int(out.nnz)
@@ -192,7 +194,7 @@ def test_bloom_round_trip_large_d():
     d = 24_653
     g = rng.normal(size=d).astype(np.float32)
     sp = sparse.topk(jnp.asarray(g), 0.01)
-    for blocked in (False, True):
+    for blocked in (False, "hash", "mod"):
         meta = bloom.BloomMeta.create(sp.k, d, fpr=0.01, policy="p0", blocked=blocked)
         payload = bloom.encode(sp, jnp.asarray(g), meta, step=3)
         out = bloom.decode(payload, meta, sp.shape, step=3)
@@ -243,3 +245,81 @@ def test_both_mode_bloom_random_policy_decodes_real_values():
     # the true gradient at the selected positions
     corr = np.corrcoef(out[nz], g[nz])[0, 1]
     assert corr > 0.8, corr
+
+
+def test_mod_blocked_structured_indices_fpr():
+    """mod-W block assignment with W odd must stay at/under target FPR for
+    the structured index sets gradients actually produce: contiguous runs
+    and power-of-2 strides (both spread perfectly round-robin mod odd W)."""
+    d = 120_000
+    k = 12_000
+    meta = bloom.BloomMeta.create(k, d, fpr=0.02, blocked="mod")
+    assert (meta.m_bits // 32) % 2 == 1  # W odd
+    for idx_np in (
+        np.arange(5000, 5000 + k, dtype=np.int32),  # contiguous run
+        (np.arange(k, dtype=np.int64) * 8 % d).astype(np.int32),  # stride 8
+    ):
+        idx_np = np.unique(idx_np)
+        kk = len(idx_np)
+        sp = sparse.SparseGrad(
+            values=jnp.ones((kk,), jnp.float32),
+            indices=jnp.asarray(idx_np),
+            nnz=jnp.int32(kk),
+            shape=(d,),
+        )
+        words = bloom.insert(sp.indices, sp.nnz, meta)
+        mask = np.asarray(bloom.query_universe(words, meta))
+        assert mask[idx_np].all()  # no false negatives
+        truth = np.zeros(d, bool)
+        truth[idx_np] = True
+        fpr = np.logical_and(mask, ~truth).sum() / (d - kk)
+        assert fpr <= 0.02 * 1.5, fpr
+
+
+def test_decode_dense_tolerates_short_value_table():
+    """'both'-mode callers may hand decode_dense a value table shorter than
+    p0's budget; positions ranked past the table get zero, not garbage."""
+    rng = np.random.default_rng(15)
+    d = 20_000
+    g = rng.normal(size=d).astype(np.float32)
+    sp = sparse.topk(jnp.asarray(g), 0.01)
+    meta = bloom.BloomMeta.create(sp.k, d, fpr=0.1, policy="p0", blocked="mod")
+    assert meta.budget > sp.k
+    payload = bloom.encode(sp, jnp.asarray(g), meta)
+    short = jnp.asarray(rng.normal(size=sp.k).astype(np.float32))
+    out = np.asarray(bloom.decode_dense(payload, meta, sp.shape, values=short))
+    # first k selected positions carry the table, the rest decode to zero
+    mask = np.asarray(bloom.query_universe(payload.words, meta))
+    want_pos = np.nonzero(mask)[0]
+    np.testing.assert_allclose(out[want_pos[: sp.k]], np.asarray(short), rtol=1e-6)
+    assert (out[want_pos[sp.k :]] == 0).all()
+
+
+def test_both_bloom_p0_round_trip():
+    """Full wrapper round trip for the flagship DRQSGD-BF-P0 shape
+    (deepreduce='both', bloom index, qsgd values, policy p0)."""
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    d = 20_000
+    cfg = DeepReduceConfig(
+        compressor="topk", compress_ratio=0.01, deepreduce="both",
+        index="bloom", value="qsgd", policy="p0", fpr=0.05,
+        bloom_blocked=True, memory="none", min_compress_size=100,
+    )
+    codec = TensorCodec((d,), cfg, name="t")
+    rng = np.random.default_rng(16)
+    g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+    payload = jax.jit(lambda t: codec.encode(t, step=0, key=key))(g)
+    out = np.asarray(jax.jit(lambda p: codec.decode(p, step=0))(payload))
+    # every true top-k position decodes near its gradient value — this
+    # checks PLACEMENT through the mapping/rank machinery; qsgd is lossy
+    # (one 127-level bucket step ~ norm/127 ~ 0.5 here), so the bound is a
+    # quantization-step bound, not an exactness bound
+    sp = codec.sparsify(g, key=key)
+    sel = np.asarray(sp.indices)[: int(sp.nnz)]
+    err = np.abs(out[sel] - np.asarray(g)[sel])
+    assert err.max() < 1.0, err.max()
+    assert np.corrcoef(out[sel], np.asarray(g)[sel])[0, 1] > 0.95
+    assert (out != 0).sum() >= int(sp.nnz)

@@ -112,38 +112,46 @@ def _encode_host(idx_bytes: np.ndarray, d: int, budget: int) -> Tuple[np.ndarray
     return out, np.int32(total)
 
 
-def _decode_host(stream: np.ndarray, nbits: int, n_syms: int, d: int) -> np.ndarray:
-    lengths, codes, order = _universe_codec(d)
-    # canonical decode tables per length
+@lru_cache(maxsize=64)
+def _decode_lut(d: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """(symbol[2^L], length[2^L], L): every L-bit window resolves its first
+    codeword in one lookup — L = max code length, ~9 bits for the
+    arange-universe codec, so the table is tiny."""
+    lengths, codes, _ = _universe_codec(d)
     max_len = int(lengths.max())
-    first_code = np.full(max_len + 1, -1, np.int64)
-    first_sym = np.zeros(max_len + 1, np.int64)
-    count = np.zeros(max_len + 1, np.int64)
-    sym_by_rank = []
-    for s in order:
+    lut_sym = np.zeros(1 << max_len, np.uint8)
+    lut_len = np.ones(1 << max_len, np.int64)
+    for s in range(256):
         length = int(lengths[s])
         if length == 0:
             continue
-        if first_code[length] < 0:
-            first_code[length] = int(codes[s])
-            first_sym[length] = len(sym_by_rank)
-        count[length] += 1
-        sym_by_rank.append(s)
-    sym_by_rank = np.asarray(sym_by_rank, np.uint8)
+        lo = int(codes[s]) << (max_len - length)
+        hi = (int(codes[s]) + 1) << (max_len - length)
+        lut_sym[lo:hi] = s
+        lut_len[lo:hi] = length
+    return lut_sym, lut_len, max_len
+
+
+def _decode_host(stream: np.ndarray, nbits: int, n_syms: int, d: int) -> np.ndarray:
+    """LUT decode: one table lookup per SYMBOL (the round-2 version walked
+    the canonical tables bit by bit in Python — unusable at ResNet-50
+    scale). Window integers are precomputed vectorized; the remaining loop
+    is O(1) numpy indexing per symbol."""
+    lut_sym, lut_len, max_len = _decode_lut(d)
     bits = np.unpackbits(stream)[:nbits]
+    padded = np.concatenate([bits, np.zeros(max_len, np.uint8)])
+    # window ints by max_len shifted adds — O(nbits) memory (a 2D
+    # sliding-window matrix would transiently be ~max_len*8 bytes/bit)
+    n = max(nbits, 1)
+    windows = np.zeros(n, np.int32)
+    for i in range(max_len):
+        windows += padded[i : i + n].astype(np.int32) << (max_len - 1 - i)
     out = np.zeros(n_syms, np.uint8)
     pos = 0
     for i in range(n_syms):
-        code = 0
-        length = 0
-        while True:
-            code = (code << 1) | int(bits[pos])
-            pos += 1
-            length += 1
-            fc = first_code[length]
-            if fc >= 0 and code - fc < count[length]:
-                out[i] = sym_by_rank[first_sym[length] + (code - fc)]
-                break
+        w = windows[pos]
+        out[i] = lut_sym[w]
+        pos += lut_len[w]
     return out
 
 

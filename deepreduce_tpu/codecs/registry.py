@@ -17,6 +17,7 @@ idx/val relative-volume accounting (pytorch/deepreduce.py:93-95,148-150).
 
 from __future__ import annotations
 
+import dataclasses as _dataclasses
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -452,9 +453,112 @@ class BloomNativeCodec(Codec):
         )
 
 
+@jax.tree_util.register_dataclass
+@_dataclasses.dataclass(frozen=True)
+class IntegerNativePayload:
+    values: jax.Array  # f32[k] — values in ascending-index order
+    wire: jax.Array  # uint32[budget_words] — named-codec wire, zero-padded
+    nwords: jax.Array  # i32[] — live wire words
+    nnz: jax.Array
+
+
+class IntegerNativeCodec(Codec):
+    """The C++ FastPFor-role family behind name-keyed selection — the
+    reference's IntegerCompressorOp with string attr `code` routed through
+    CODECFactory::getFromName (integer_compression.cc:20-42,62). Members:
+    fbp (frame bit packing), varint (VByte), pfor (PFor128 with patched
+    exceptions). Host path via pure_callback with a static wire budget."""
+
+    kind = "index"
+    order_preserving = False  # sorts ascending, like IntegerCodec
+    fixed_size = False
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        self.code = str(self.params.get("code", "fbp"))
+        from deepreduce_tpu import native
+
+        if self.code not in native.INT_CODEC_NAMES:
+            raise KeyError(
+                f"unknown integer codec {self.code!r}; have {native.INT_CODEC_NAMES}"
+            )
+        # static budget: the family-wide worst case (b=32 pfor blocks /
+        # 5-byte varints), matching int_codec_from_name's encode cap
+        self.budget_words = 2 * k + 2 * ((k + 127) // 128) + 16
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        import numpy as np
+
+        from deepreduce_tpu import native
+
+        k, budget = self.k, self.budget_words
+        code = self.code
+
+        def host(idx_np, val_np, nnz_np):
+            enc, _ = native.int_codec_from_name(code)
+            n = int(nnz_np)
+            order = np.argsort(idx_np[:n], kind="stable")
+            words = enc(idx_np[:n][order])
+            out_w = np.zeros(budget, np.uint32)
+            out_w[: len(words)] = words
+            out_v = np.zeros(k, np.float32)
+            out_v[:n] = val_np[:n][order]
+            return out_w, np.int32(len(words)), out_v
+
+        wire, nwords, values = jax.pure_callback(
+            host,
+            (
+                jax.ShapeDtypeStruct((budget,), jnp.uint32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((k,), jnp.float32),
+            ),
+            sp.indices, sp.values, sp.nnz,
+        )
+        return IntegerNativePayload(
+            values=values, wire=wire, nwords=nwords, nnz=sp.nnz
+        )
+
+    def decode(self, payload, shape, *, step=0):
+        import numpy as np  # noqa: F401 (host fn below)
+
+        from deepreduce_tpu import native  # noqa: F401
+
+        k = self.k
+        code = self.code
+
+        def host(wire_np, nwords_np, nnz_np):
+            _, dec = native.int_codec_from_name(code)
+            idx = dec(wire_np[: int(nwords_np)], int(nnz_np))
+            out = np.zeros(k, np.int32)
+            out[: len(idx)] = idx.astype(np.int32)
+            return out
+
+        idx = jax.pure_callback(
+            host,
+            jax.ShapeDtypeStruct((k,), jnp.int32),
+            payload.wire, payload.nwords, payload.nnz,
+        )
+        live = jnp.arange(k, dtype=jnp.int32) < payload.nnz
+        from deepreduce_tpu.sparse import SparseGrad
+
+        return SparseGrad(
+            values=jnp.where(live, payload.values, 0.0),
+            indices=jnp.where(live, idx, 0),
+            nnz=payload.nnz,
+            shape=shape,
+        )
+
+    def index_wire_bits(self, payload):
+        return payload.nwords.astype(jnp.float32) * 32
+
+    def value_wire_bits(self, payload):
+        return _raw_value_bits(payload.nnz)
+
+
 INDEX_CODECS: Dict[str, type] = {
     "bloom": BloomCodec,
     "bloom_native": BloomNativeCodec,
+    "integer_native": IntegerNativeCodec,
     "rle": RLECodec,
     "integer": IntegerCodec,
     "huffman": HuffmanCodec,

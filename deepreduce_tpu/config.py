@@ -42,6 +42,10 @@ class DeepReduceConfig:
     # W odd — the universe query becomes a pure broadcast, zero gathers
     # (measured-fastest TPU variant)
     bloom_blocked: Any = False  # False | True | 'hash' | 'mod'
+    # native integer-codec family member for index='integer_native' — the
+    # reference op's string attr `code` routed through
+    # CODECFactory::getFromName (integer_compression.cc:62)
+    code: str = "fbp"  # fbp | varint | pfor
     poly_degree: int = 5
     quantum_num: int = 127
     bucket_size: int = 512
@@ -55,8 +59,11 @@ class DeepReduceConfig:
     # (the reference's shape, one allgather per hook fire,
     # pytorch/deepreduce.py:54-61).
     fused: bool = True
-    # small-tensor bypass (pytorch/deepreduce.py:68)
-    min_compress_size: int = 1000
+    # small-tensor bypass (pytorch/deepreduce.py:68). None = the reference
+    # default for the selected codec: 1000 (PyTorch generic gate), or 9000
+    # when value='doubleexp' (tensorflow/deepreduce.py:396,426). An explicit
+    # int always wins.
+    min_compress_size: Optional[int] = None
     # per-layer whitelist: regex on the tensor's pytree path; non-matching
     # tensors pass through uncompressed. The data-driven form of TF PolySeg's
     # hard-coded conv-layer whitelist (tensorflow/deepreduce.py:458,526
@@ -65,11 +72,28 @@ class DeepReduceConfig:
     # observability
     micro_benchmark: bool = False
 
+    @classmethod
+    def tpu_defaults(cls, **overrides) -> "DeepReduceConfig":
+        """The measured-fastest TPU configuration (bench.py, real v5e):
+        approx top-k sparsifier (~4x faster than exact at d=4M), mod-blocked
+        bloom (gather-free universe query), fused single-buffer exchange,
+        and Pallas kernels where present (QSGD PRNG). Every knob here won
+        its A/B on silicon; override freely for experiments."""
+        base = dict(
+            approx_topk=True,
+            bloom_blocked="mod",
+            fused=True,
+            use_pallas=True,
+        )
+        base.update(overrides)
+        return cls(**base)
+
     def codec_params(self) -> Dict[str, Any]:
         return {
             "fpr": self.fpr,
             "policy": self.policy,
             "bloom_blocked": self.bloom_blocked,
+            "code": self.code,
             "poly_degree": self.poly_degree,
             "quantum_num": self.quantum_num,
             "bucket_size": self.bucket_size,

@@ -24,9 +24,11 @@ Design notes (TPU-native, vs the reference's 57-VM AWS testbed):
   from it only by not-yet-delivered delta mass. This keeps state O(model), not
   O(clients x model) — except the per-client C2S residuals, which are the
   price of client-side error feedback (paper keeps these on each device).
-- Local training is an unrolled loop over the C sampled clients of a
-  `lax.scan` over local steps — C is static, so XLA sees one fused
-  program per round.
+- The round is ONE `lax.scan` over the stacked client axis (each body
+  iteration is itself a `lax.scan` over local steps), so the compiled
+  program size is independent of the number of sampled clients — the
+  paper's 56-client rounds compile exactly one copy of
+  local-train + codec.
 """
 
 from __future__ import annotations
@@ -187,29 +189,56 @@ class FedAvg:
         w_ref = jax.tree_util.tree_map(jnp.add, state.w_ref, dec_delta)
 
         # --- local training + C2S on each sampled client -----------------
-        upd_sum = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        # ONE lax.scan over the stacked client axis: the compiled program
+        # size is independent of C (the paper's 56-client config would
+        # otherwise build 56 copies of local-train + codec). Residuals for
+        # the sampled ids are gathered up front and scattered back after —
+        # ids are drawn without replacement, so the batched scatter is
+        # collision-free.
         c2s_res = state.c2s_residuals
-        wires = [wire_s2c]
-        for c in range(C):
-            batch_c = jax.tree_util.tree_map(lambda x: x[c], client_batches)
+        use_res = c2s_res is not None
+        res_stack = (
+            jax.tree_util.tree_map(lambda r: r[ids], c2s_res) if use_res else None
+        )
+        upd_sum0 = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        wire0 = WireStats(
+            index_bits=jnp.zeros((), jnp.float32),
+            value_bits=jnp.zeros((), jnp.float32),
+            dense_bits=jnp.zeros((), jnp.float32),
+        )
+
+        def client_body(carry, xs):
+            upd_sum, wire_acc = carry
+            if use_res:
+                c, batch_c, res_c = xs
+            else:
+                c, batch_c = xs
+                res_c = None
             p_end = self._local_train(
                 w_ref, batch_c, jax.random.fold_in(key_c2s, 2 * c)
             )
             update = jax.tree_util.tree_map(lambda a, b: a - b, p_end, w_ref)
-            res_c = (
-                jax.tree_util.tree_map(lambda r: r[ids[c]], c2s_res)
-                if c2s_res is not None
-                else None
-            )
             dec_upd, new_res_c, wire_c = self._compress_tree(
                 "c2s", update, res_c, state.round, jax.random.fold_in(key_c2s, 2 * c + 1)
             )
             upd_sum = jax.tree_util.tree_map(jnp.add, upd_sum, dec_upd)
-            if c2s_res is not None:
-                c2s_res = jax.tree_util.tree_map(
-                    lambda buf, nr: buf.at[ids[c]].set(nr), c2s_res, new_res_c
-                )
-            wires.append(wire_c)
+            wire_acc = WireStats(
+                index_bits=wire_acc.index_bits + wire_c.index_bits,
+                value_bits=wire_acc.value_bits + wire_c.value_bits,
+                dense_bits=wire_acc.dense_bits + wire_c.dense_bits,
+            )
+            return (upd_sum, wire_acc), (new_res_c if use_res else 0)
+
+        cs = jnp.arange(C, dtype=jnp.uint32)
+        xs = (cs, client_batches, res_stack) if use_res else (cs, client_batches)
+        (upd_sum, wire_c2s), new_res_stack = jax.lax.scan(
+            client_body, (upd_sum0, wire0), xs
+        )
+        if use_res:
+            c2s_res = jax.tree_util.tree_map(
+                lambda buf, nr: buf.at[ids].set(nr), c2s_res, new_res_stack
+            )
+        wires = [wire_s2c, wire_c2s]
 
         mean_upd = jax.tree_util.tree_map(lambda s: s / C, upd_sum)
         new_params = jax.tree_util.tree_map(

@@ -73,6 +73,14 @@ def load() -> ctypes.CDLL:
     lib.drn_varint_encode.argtypes = [u32p, i32, u8p, i32]
     lib.drn_varint_decode.restype = i32
     lib.drn_varint_decode.argtypes = [u8p, i32, u32p, i32]
+    lib.drn_pfor_encode.restype = i32
+    lib.drn_pfor_encode.argtypes = [u32p, i32, u32p, i32]
+    lib.drn_pfor_decode.restype = i32
+    lib.drn_pfor_decode.argtypes = [u32p, i32, u32p, i32]
+    lib.drn_int_encode_named.restype = i32
+    lib.drn_int_encode_named.argtypes = [ctypes.c_char_p, u32p, i32, u32p, i32]
+    lib.drn_int_decode_named.restype = i32
+    lib.drn_int_decode_named.argtypes = [ctypes.c_char_p, u32p, i32, u32p, i32]
     _lib = lib
     return lib
 
@@ -196,3 +204,63 @@ def varint_decode(data: np.ndarray, n_max: int) -> np.ndarray:
     out = np.zeros(n_max, np.uint32)
     n = lib.drn_varint_decode(_ptr(b, ctypes.c_uint8), len(b), _ptr(out, ctypes.c_uint32), n_max)
     return out[:n]
+
+
+def pfor_encode(sorted_vals: np.ndarray) -> np.ndarray:
+    """PFor128 with patched exceptions over the sorted values' deltas."""
+    lib = load()
+    v = np.ascontiguousarray(sorted_vals, np.uint32)
+    # worst case: every block falls back to b=32 (header + full words)
+    cap = 1 + len(v) + 2 * ((len(v) + 127) // 128) + 8
+    out = np.zeros(cap, np.uint32)
+    n = lib.drn_pfor_encode(_ptr(v, ctypes.c_uint32), len(v), _ptr(out, ctypes.c_uint32), cap)
+    if n < 0:
+        raise ValueError(f"pfor_encode capacity {n}")
+    return out[:n]
+
+
+def pfor_decode(words: np.ndarray, n_max: int) -> np.ndarray:
+    lib = load()
+    w = np.ascontiguousarray(words, np.uint32)
+    out = np.zeros(n_max, np.uint32)
+    n = lib.drn_pfor_decode(_ptr(w, ctypes.c_uint32), len(w), _ptr(out, ctypes.c_uint32), n_max)
+    if n < 0:
+        raise ValueError(f"pfor_decode error {n}")
+    return out[:n]
+
+
+INT_CODEC_NAMES = ("fbp", "varint", "pfor")
+
+
+def int_codec_from_name(name: str):
+    """(encode, decode) for a named integer-codec family member — the
+    CODECFactory::getFromName role (/root/reference/tensorflow/
+    integer_compression.cc:62,161). Every member shares the words-in /
+    words-out shape; unknown names raise like the factory does."""
+    lib = load()
+    cname = name.encode()
+    if lib.drn_int_encode_named(cname, None, 0, None, 0) == -100:
+        raise KeyError(f"unknown integer codec {name!r}; have {INT_CODEC_NAMES}")
+
+    def enc(sorted_vals: np.ndarray) -> np.ndarray:
+        v = np.ascontiguousarray(sorted_vals, np.uint32)
+        cap = 2 * len(v) + 2 * ((len(v) + 127) // 128) + 16
+        out = np.zeros(cap, np.uint32)
+        n = lib.drn_int_encode_named(
+            cname, _ptr(v, ctypes.c_uint32), len(v), _ptr(out, ctypes.c_uint32), cap
+        )
+        if n < 0:
+            raise ValueError(f"{name} encode error {n}")
+        return out[:n]
+
+    def dec(words: np.ndarray, n_max: int) -> np.ndarray:
+        w = np.ascontiguousarray(words, np.uint32)
+        out = np.zeros(max(1, n_max), np.uint32)
+        n = lib.drn_int_decode_named(
+            cname, _ptr(w, ctypes.c_uint32), len(w), _ptr(out, ctypes.c_uint32), n_max
+        )
+        if n < 0:
+            raise ValueError(f"{name} decode error {n}")
+        return out[:n]
+
+    return enc, dec

@@ -17,8 +17,10 @@
 // - the integer codec implements delta + frame-bit-packing in the exact
 //   bit layout of deepreduce_tpu/codecs/packing.py (value i bit b at
 //   stream position i*width+b, LSB-first within little-endian uint32
-//   words) plus a VByte/varint variant — the FastPFor delta/PFor/VByte
-//   family role (integer_compression.cc:62).
+//   words), a VByte/varint variant, and PFor128 with patched exceptions —
+//   the FastPFor delta/PFor/VByte family role, selectable by name through
+//   drn_int_{en,de}code_named (CODECFactory::getFromName,
+//   integer_compression.cc:62).
 //
 // Exposed as a plain C ABI for ctypes; see native/__init__.py.
 
@@ -26,6 +28,7 @@
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <string>
 #include <vector>
 
 extern "C" {
@@ -369,6 +372,187 @@ int32_t drn_varint_decode(const uint8_t* data, int32_t len, uint32_t* out,
     out[n++] = prev;
   }
   return n;
+}
+
+// ----------------------------------------------------------------------
+// PFor with patched exceptions (the FastPFor "PFor/NewPFD" family member,
+// /root/reference/tensorflow/integer_compression.cc CODECFactory role):
+// deltas in blocks of 128; per block an exact-cost-minimized base width b
+// stores the low b bits in a frame, and values that overflow b become
+// *patched exceptions* — their in-block positions (1 byte each, 4/word)
+// plus their full 32-bit deltas appended after the frame.
+//
+// Wire: [u32 n | blocks...]; block = [u32 (b<<16|n_exc) | frame words |
+// position words | exception words].
+
+static const int32_t kPforBlock = 128;
+
+int32_t drn_pfor_encode(const uint32_t* sorted_vals, int32_t n,
+                        uint32_t* out_words, int32_t capacity_words) {
+  int64_t pos = 1;  // out_words[0] = n
+  if (capacity_words < 1) return -1;
+  out_words[0] = (uint32_t)n;
+  uint32_t prev = 0;
+  for (int32_t start = 0; start < n; start += kPforBlock) {
+    int32_t len = (n - start) < kPforBlock ? (n - start) : kPforBlock;
+    uint32_t deltas[kPforBlock];
+    for (int32_t i = 0; i < len; ++i) {
+      uint32_t v = sorted_vals[start + i];
+      deltas[i] = v - prev;
+      prev = v;
+    }
+    // exact cost scan: frame bits + 8 bits/exception position + 32/value
+    uint32_t best_b = 32;
+    int64_t best_cost = (int64_t)len * 32;
+    for (uint32_t b = 0; b <= 31; ++b) {
+      int32_t n_exc = 0;
+      for (int32_t i = 0; i < len; ++i)
+        if (b == 0 ? deltas[i] != 0 : (deltas[i] >> b) != 0) ++n_exc;
+      int64_t cost = (int64_t)len * b + (int64_t)n_exc * (8 + 32);
+      if (cost < best_cost) {
+        best_cost = cost;
+        best_b = b;
+      }
+    }
+    uint32_t b = best_b;
+    uint8_t exc_pos[kPforBlock];
+    uint32_t exc_val[kPforBlock];
+    int32_t n_exc = 0;
+    for (int32_t i = 0; i < len; ++i)
+      if (b == 32 ? false : (b == 0 ? deltas[i] != 0 : (deltas[i] >> b) != 0)) {
+        exc_pos[n_exc] = (uint8_t)i;
+        exc_val[n_exc] = deltas[i];
+        ++n_exc;
+      }
+    int64_t frame_words = ((int64_t)len * b + 31) / 32;
+    int64_t pos_words = (n_exc + 3) / 4;
+    int64_t need = 1 + frame_words + pos_words + n_exc;
+    if (pos + need > capacity_words) return -(int32_t)(pos + need);
+    out_words[pos] = (b << 16) | (uint32_t)n_exc;
+    uint32_t* frame = out_words + pos + 1;
+    std::memset(frame, 0, (size_t)frame_words * 4);
+    if (b > 0 && b < 32) {
+      uint32_t mask = (b == 32) ? 0xffffffffu : ((1u << b) - 1u);
+      for (int32_t i = 0; i < len; ++i) {
+        uint32_t low = deltas[i] & mask;
+        uint64_t base = (uint64_t)i * b;
+        for (uint32_t bit = 0; bit < b; ++bit)
+          if ((low >> bit) & 1u) set_stream_bit(frame, base + bit);
+      }
+    } else if (b == 32) {
+      for (int32_t i = 0; i < len; ++i) frame[i] = deltas[i];
+    }
+    uint32_t* pwords = frame + frame_words;
+    std::memset(pwords, 0, (size_t)pos_words * 4);
+    for (int32_t e = 0; e < n_exc; ++e)
+      pwords[e >> 2] |= (uint32_t)exc_pos[e] << (8 * (e & 3));
+    uint32_t* evals = pwords + pos_words;
+    for (int32_t e = 0; e < n_exc; ++e) evals[e] = exc_val[e];
+    pos += need;
+  }
+  return (int32_t)pos;
+}
+
+int32_t drn_pfor_decode(const uint32_t* words, int32_t nwords, uint32_t* out,
+                        int32_t cap) {
+  if (nwords < 1) return -1;
+  int32_t n = (int32_t)words[0];
+  if (n > cap) return -2;
+  int64_t pos = 1;
+  uint32_t prev = 0;
+  for (int32_t start = 0; start < n; start += kPforBlock) {
+    int32_t len = (n - start) < kPforBlock ? (n - start) : kPforBlock;
+    if (pos >= nwords) return -3;
+    uint32_t hdr = words[pos];
+    uint32_t b = hdr >> 16;
+    int32_t n_exc = (int32_t)(hdr & 0xffffu);
+    if (b > 32 || n_exc > len) return -4;
+    int64_t frame_words = ((int64_t)len * b + 31) / 32;
+    int64_t pos_words = (n_exc + 3) / 4;
+    if (pos + 1 + frame_words + pos_words + n_exc > nwords) return -5;
+    const uint32_t* frame = words + pos + 1;
+    uint32_t deltas[kPforBlock];
+    if (b == 32) {
+      for (int32_t i = 0; i < len; ++i) deltas[i] = frame[i];
+    } else if (b == 0) {
+      for (int32_t i = 0; i < len; ++i) deltas[i] = 0;
+    } else {
+      for (int32_t i = 0; i < len; ++i) {
+        uint32_t v = 0;
+        uint64_t base = (uint64_t)i * b;
+        for (uint32_t bit = 0; bit < b; ++bit)
+          v |= get_stream_bit(frame, base + bit) << bit;
+        deltas[i] = v;
+      }
+    }
+    const uint32_t* pwords = frame + frame_words;
+    const uint32_t* evals = pwords + pos_words;
+    for (int32_t e = 0; e < n_exc; ++e) {
+      uint32_t p = (pwords[e >> 2] >> (8 * (e & 3))) & 0xffu;
+      if ((int32_t)p < len) deltas[p] = evals[e];
+    }
+    for (int32_t i = 0; i < len; ++i) {
+      prev += deltas[i];
+      out[start + i] = prev;
+    }
+    pos += 1 + frame_words + pos_words + n_exc;
+  }
+  return n;
+}
+
+// ----------------------------------------------------------------------
+// Name-keyed codec selection — the CODECFactory::getFromName role
+// (/root/reference/tensorflow/integer_compression.cc:62): one entry point,
+// member chosen by string. varint's byte stream rides in words behind a
+// [u32 nbytes] header so every member shares the words-in/words-out shape.
+
+static int32_t pfor_name_id(const char* name) {
+  std::string s(name ? name : "");
+  if (s == "fbp" || s == "fastbinarypacking32") return 0;
+  if (s == "varint" || s == "vbyte") return 1;
+  if (s == "pfor" || s == "pfor128" || s == "newpfd") return 2;
+  return -1;
+}
+
+int32_t drn_int_encode_named(const char* name, const uint32_t* sorted_vals,
+                             int32_t n, uint32_t* out_words,
+                             int32_t capacity_words) {
+  switch (pfor_name_id(name)) {
+    case 0:
+      return drn_fbp_encode(sorted_vals, n, out_words, capacity_words);
+    case 1: {
+      if (capacity_words < 1) return -1;
+      int32_t nbytes = drn_varint_encode(
+          sorted_vals, n, reinterpret_cast<uint8_t*>(out_words + 1),
+          (capacity_words - 1) * 4);
+      if (nbytes < 0) return nbytes;
+      out_words[0] = (uint32_t)nbytes;
+      return 1 + (nbytes + 3) / 4;
+    }
+    case 2:
+      return drn_pfor_encode(sorted_vals, n, out_words, capacity_words);
+    default:
+      return -100;  // unknown codec name
+  }
+}
+
+int32_t drn_int_decode_named(const char* name, const uint32_t* words,
+                             int32_t nwords, uint32_t* out, int32_t cap) {
+  switch (pfor_name_id(name)) {
+    case 0:
+      return drn_fbp_decode(words, nwords, out, cap);
+    case 1: {
+      if (nwords < 1) return -1;
+      int32_t nbytes = (int32_t)words[0];
+      if (nbytes > (nwords - 1) * 4) return -2;
+      return drn_varint_decode(reinterpret_cast<const uint8_t*>(words + 1),
+                               nbytes, out, cap);
+    }
+    case 2:
+      return drn_pfor_decode(words, nwords, out, cap);
+    default:
+      return -100;
+  }
 }
 
 }  // extern "C"

@@ -88,7 +88,10 @@ def quantize_levels_xla(values: jax.Array, scale: jax.Array, key: jax.Array) -> 
 def quantize_levels(
     values: jax.Array, scale: jax.Array, key: jax.Array, *, use_pallas: bool = False
 ) -> jax.Array:
-    if use_pallas:
+    # Pallas TPU kernels don't lower on the CPU backend; degrade to the XLA
+    # path silently so `DeepReduceConfig.tpu_defaults()` stays portable
+    # (tests and the virtual-mesh dry runs all run on CPU).
+    if use_pallas and jax.default_backend() != "cpu":
         seed = jax.random.randint(key, (), 0, 2**31 - 1, jnp.int32)
         return quantize_levels_pallas(values, scale, seed)
     return quantize_levels_xla(values, scale, key)

@@ -133,6 +133,55 @@ def randomk(
     )
 
 
+def natural_sparsity(tensor: jax.Array, threshold_val: float = 0.0) -> jax.Array:
+    """Fraction of elements strictly above `threshold_val` in magnitude —
+    the model's true sparsity at this step. With 0.0 this counts nonzeros
+    (the NCF embedding-gradient case, run_deepreduce.sh:89)."""
+    flat = tensor.reshape(-1)
+    if threshold_val <= 0.0:
+        passing = flat != 0
+    else:
+        passing = jnp.abs(flat) >= threshold_val
+    return jnp.mean(passing.astype(jnp.float32))
+
+
+def calibrate_threshold_budget(
+    sample_grads, threshold_val: float = 0.0, *, safety: float = 1.25
+) -> float:
+    """budget_ratio for `threshold` measured from sample gradients: the max
+    observed natural sparsity across leaves times a safety headroom,
+    clipped to [1/d-ish, 1.0]. Host-side, called once before building the
+    codec — the static-shape answer to the reference's dynamic-size
+    above-threshold list (tensorflow/deepreduce.py:283-298)."""
+    import numpy as np
+
+    worst = 0.0
+    for leaf in jax.tree_util.tree_leaves(sample_grads):
+        worst = max(worst, float(natural_sparsity(jnp.asarray(leaf), threshold_val)))
+    return float(np.clip(worst * safety, 1e-6, 1.0))
+
+
+def threshold_overflow(
+    tensor: jax.Array, threshold_val: float, *, budget_ratio: float = 1.0
+) -> jax.Array:
+    """How many above-threshold elements did NOT fit the static budget this
+    step (0 = the budget captured true natural sparsity). The reference
+    transmits every above-threshold element (tensorflow/deepreduce.py:
+    283-298); under static shapes overflow is the fidelity loss to watch —
+    dump it per step (`logging_utils.DumpLogger`) or assert it stays 0."""
+    flat = tensor.reshape(-1)
+    d = flat.shape[0]
+    k = num_slots(d, budget_ratio)
+    mags = jnp.abs(flat)
+    if threshold_val <= 0.0:
+        passing = flat != 0
+    else:
+        thr = jnp.minimum(jnp.asarray(threshold_val, flat.dtype), jnp.max(mags))
+        passing = mags >= thr
+    n_above = jnp.sum(passing.astype(jnp.int32))
+    return jnp.maximum(n_above - k, 0)
+
+
 def threshold(tensor: jax.Array, threshold_val: float, *, budget_ratio: float = 1.0) -> SparseGrad:
     """Keep |g| >= max(threshold, needed-to-fit-budget).
 
@@ -141,9 +190,9 @@ def threshold(tensor: jax.Array, threshold_val: float, *, budget_ratio: float = 
     index list. Static-shape version: the slot budget is
     ``d * budget_ratio``; if more elements pass the threshold than fit, the
     largest-magnitude ones win. ``threshold_val=0.0`` captures natural
-    sparsity (the NCF config, run_deepreduce.sh:89) — with 0.0 strictly
-    *greater-equal* every element passes, so pair it with a budget_ratio
-    sized to the model's true sparsity.
+    sparsity (the NCF config, run_deepreduce.sh:89) — size the budget with
+    `calibrate_threshold_budget` and watch `threshold_overflow` to verify
+    the static budget really captures it.
     """
     flat = tensor.reshape(-1)
     d = flat.shape[0]
@@ -152,6 +201,11 @@ def threshold(tensor: jax.Array, threshold_val: float, *, budget_ratio: float = 
     thr = jnp.minimum(jnp.asarray(threshold_val, flat.dtype), jnp.max(mags))
     vals_top, idxs = jax.lax.top_k(mags, k)
     keep = vals_top >= thr
+    if threshold_val <= 0.0:
+        # >= 0.0 would admit exact zeros (everything); natural sparsity
+        # means nonzeros only (the reference's dynamic list contains only
+        # gradient-touched elements)
+        keep = jnp.logical_and(keep, vals_top > 0)
     nnz = jnp.sum(keep).astype(jnp.int32)
     # Compact live slots to the front, preserving ascending index order.
     idxs = jnp.where(keep, idxs, d)  # push dead slots to the end of the sort

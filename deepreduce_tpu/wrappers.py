@@ -78,13 +78,29 @@ class TensorCodec:
         # different: small tensors are still sparsified, just not
         # codec-compressed (pytorch/deepreduce.py:68 returns the sparsifier
         # output).
+        # Per-codec gate parity when the knobs are left unset: TF DoubleExp
+        # compresses only above 9000 elements (the generic PyTorch gate is
+        # 1000; tensorflow/deepreduce.py:396,426), and TF PolySeg applies
+        # only to convolutional layers — its hard-coded per-model size
+        # whitelist (:458,515-516 is_convolutional) becomes a name-pattern
+        # default here. Explicit settings always win
+        # (min_compress_size=None means "reference default"; pass
+        # layer_pattern='.*' to run polyseg on every layer).
+        uses_value = cfg.deepreduce in ("value", "both")
+        min_size = cfg.min_compress_size
+        if min_size is None:
+            min_size = 9000 if uses_value and cfg.value == "doubleexp" else 1000
+        pattern = cfg.layer_pattern
+        if uses_value and cfg.value == "polyseg" and pattern is None:
+            pattern = r"(?i)conv"
+        self.min_compress_size = min_size
+        self.layer_pattern = pattern
         self.pattern_excluded = (
-            cfg.layer_pattern is not None
-            and re.search(cfg.layer_pattern, name) is None
+            pattern is not None and re.search(pattern, name) is None
         )
         self.compressed = (
             cfg.deepreduce is not None
-            and self.d > cfg.min_compress_size
+            and self.d > min_size
             and not self.pattern_excluded
         )
         if cfg.compressor == "none":

@@ -169,3 +169,86 @@ def test_bloom_native_rejected_in_both_mode():
                            min_compress_size=100)
     with _pytest.raises(ValueError, match="index-mode only"):
         TensorCodec((4096,), cfg, name="t")
+
+
+# ------------------- FastPFor-family name-keyed codecs -------------------- #
+
+
+def _sorted_indices(rng, k, d):
+    return np.sort(rng.choice(d, size=k, replace=False)).astype(np.uint32)
+
+
+@pytest.mark.parametrize("name", ["fbp", "varint", "pfor"])
+def test_int_codec_family_round_trip(name):
+    """Every named member (CODECFactory::getFromName role) round-trips
+    sorted index arrays exactly."""
+    native = pytest.importorskip("deepreduce_tpu.native")
+    try:
+        enc, dec = native.int_codec_from_name(name)
+    except OSError:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(0)
+    for k, d in ((1, 10), (100, 1000), (5000, 200000)):
+        idx = _sorted_indices(rng, k, d)
+        words = enc(idx)
+        out = dec(words, k)
+        np.testing.assert_array_equal(out, idx)
+
+
+def test_int_codec_unknown_name_raises():
+    native = pytest.importorskip("deepreduce_tpu.native")
+    try:
+        native.load()
+    except OSError:
+        pytest.skip("native lib unavailable")
+    with pytest.raises(KeyError):
+        native.int_codec_from_name("simdpfor9000")
+
+
+def test_pfor_patched_exceptions_beat_fbp_on_skewed_deltas():
+    """PFor's point: FBP pays the max delta's width for EVERY element; PFor
+    patches the few outliers as exceptions. A run of dense indices with a
+    handful of giant jumps must compress strictly smaller under pfor."""
+    native = pytest.importorskip("deepreduce_tpu.native")
+    try:
+        enc_p, dec_p = native.int_codec_from_name("pfor")
+        enc_f, _ = native.int_codec_from_name("fbp")
+    except OSError:
+        pytest.skip("native lib unavailable")
+    # 2000 mostly-consecutive indices with 8 jumps of ~1M (delta width 20+)
+    deltas = np.ones(2000, np.uint64)
+    deltas[::250] = 1_000_003
+    idx = np.cumsum(deltas).astype(np.uint32)
+    w_pfor = enc_p(idx)
+    w_fbp = enc_f(idx)
+    np.testing.assert_array_equal(dec_p(w_pfor, len(idx)), idx)
+    assert len(w_pfor) < len(w_fbp) // 2, (len(w_pfor), len(w_fbp))
+
+
+def test_integer_native_codec_config_selectable():
+    """index='integer_native' + code=<member> flows from config through the
+    registry wrapper and round-trips inside jit."""
+    pytest.importorskip("deepreduce_tpu.native")
+    import jax
+    import jax.numpy as jnp
+
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    d = 50_000
+    rng = np.random.default_rng(3)
+    g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    for code in ("fbp", "varint", "pfor"):
+        cfg = DeepReduceConfig(
+            compressor="topk", compress_ratio=0.02, deepreduce="index",
+            index="integer_native", code=code, memory="none",
+            min_compress_size=100,
+        )
+        codec = TensorCodec((d,), cfg, name=f"t_{code}")
+        key = jax.random.PRNGKey(0)
+        payload = jax.jit(lambda t: codec.encode(t, step=0, key=key))(g)
+        out = np.asarray(jax.jit(lambda p: codec.decode(p, step=0))(payload))
+        sp = codec.sparsify(g, key=key)
+        sel = np.asarray(sp.indices)[: int(sp.nnz)]
+        np.testing.assert_allclose(out[sel], np.asarray(g)[sel], rtol=1e-6)
+        assert int(codec.wire_stats(payload).total_bits) < d * 32

@@ -123,3 +123,27 @@ def test_per_tensor_key_distinct():
     k3 = sparse.per_tensor_key(base, "a/kernel", jnp.asarray(1))
     assert not np.array_equal(np.asarray(k1), np.asarray(k2))
     assert not np.array_equal(np.asarray(k1), np.asarray(k3))
+
+
+def test_threshold_zero_natural_sparsity_and_overflow():
+    """threshold 0.0 = natural sparsity (nonzeros only, the NCF config):
+    zeros are NOT selected, the calibrated budget captures every nonzero
+    (overflow 0), and an undersized budget reports exactly the excess."""
+    d = 10_000
+    rng = np.random.default_rng(31)
+    g = np.zeros(d, np.float32)
+    nz = rng.choice(d, 700, replace=False)
+    g[nz] = rng.normal(size=700).astype(np.float32)
+    t = jnp.asarray(g)
+
+    assert abs(float(sparse.natural_sparsity(t)) - 0.07) < 1e-6
+    ratio = sparse.calibrate_threshold_budget({"g": t}, 0.0, safety=1.2)
+    assert 0.07 <= ratio <= 0.09
+
+    sp = sparse.threshold(t, 0.0, budget_ratio=ratio)
+    assert int(sp.nnz) == 700  # all nonzeros, no zeros padded in
+    sel = np.sort(np.asarray(sp.indices)[:700])
+    np.testing.assert_array_equal(sel, np.sort(nz))
+    assert int(sparse.threshold_overflow(t, 0.0, budget_ratio=ratio)) == 0
+    # undersized budget: overflow reports the uncaptured nonzeros
+    assert int(sparse.threshold_overflow(t, 0.0, budget_ratio=500 / d)) == 200

@@ -186,3 +186,72 @@ def test_both_mode_full_matrix(index_codec, value_codec):
     err = np.abs(out[top] - np.asarray(g)[top]).mean()
     # bloom pairs admit FP displacement error; exact-index codecs are tighter
     assert err < (0.25 if index_codec == "bloom" else 0.08), err
+
+
+def test_tpu_defaults_preset_round_trips_on_cpu():
+    """The measured-best preset (approx_topk + mod-blocked bloom + fused +
+    pallas) must stay portable: on the CPU backend the pallas knob degrades
+    to the XLA path and the full flagship shape still round-trips."""
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    cfg = DeepReduceConfig.tpu_defaults(
+        compressor="topk", compress_ratio=0.02, deepreduce="both",
+        index="bloom", value="qsgd", policy="p0", fpr=0.05,
+        memory="none", min_compress_size=100,
+    )
+    assert cfg.approx_topk and cfg.fused and cfg.use_pallas
+    assert cfg.bloom_blocked == "mod"
+    d = 8192
+    codec = TensorCodec((d,), cfg, name="t")
+    rng = np.random.default_rng(21)
+    g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+    payload = jax.jit(lambda t: codec.encode(t, step=0, key=key))(g)
+    out = np.asarray(jax.jit(lambda p: codec.decode(p, step=0))(payload))
+    assert np.isfinite(out).all() and (out != 0).sum() > 0
+
+
+def test_doubleexp_9000_gate_default():
+    """Reference parity (tensorflow/deepreduce.py:396,426): with the knobs
+    left at defaults, DoubleExp compresses only tensors > 9000 elements;
+    the generic gate stays 1000; explicit settings win."""
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    dexp = DeepReduceConfig(deepreduce="value", value="doubleexp")
+    assert not TensorCodec((5000,), dexp, name="w").compressed
+    assert TensorCodec((9001,), dexp, name="w").compressed
+    # generic codecs keep the 1000-element PyTorch gate
+    qsgd = DeepReduceConfig(deepreduce="value", value="qsgd")
+    assert TensorCodec((5000,), qsgd, name="w").compressed
+    # explicit min_compress_size overrides the per-codec default — even
+    # when set to the generic default value itself
+    explicit = DeepReduceConfig(
+        deepreduce="value", value="doubleexp", min_compress_size=100
+    )
+    assert TensorCodec((5000,), explicit, name="w").compressed
+    explicit_1000 = DeepReduceConfig(
+        deepreduce="value", value="doubleexp", min_compress_size=1000
+    )
+    assert TensorCodec((5000,), explicit_1000, name="w").compressed
+
+
+def test_polyseg_conv_whitelist_default():
+    """Reference parity (tensorflow/deepreduce.py:458,515-516): with no
+    layer_pattern set, PolySeg applies only to conv-named layers; others
+    pass through uncompressed. An explicit pattern wins."""
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    pseg = DeepReduceConfig(deepreduce="value", value="polyseg")
+    assert TensorCodec((20000,), pseg, name="Conv_3/kernel").compressed
+    assert not TensorCodec((20000,), pseg, name="Dense_0/kernel").compressed
+    # other value codecs are unaffected by the polyseg default
+    qsgd = DeepReduceConfig(deepreduce="value", value="qsgd")
+    assert TensorCodec((20000,), qsgd, name="Dense_0/kernel").compressed
+    # explicit pattern overrides the conv default
+    explicit = DeepReduceConfig(
+        deepreduce="value", value="polyseg", layer_pattern="Dense"
+    )
+    assert TensorCodec((20000,), explicit, name="Dense_0/kernel").compressed

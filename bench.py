@@ -276,7 +276,7 @@ def _exchange_subprocess(d: int, workers: int, pin_cpu: bool, timeout: int) -> d
     # calls jax.config.update("jax_platforms", "axon") at interpreter start,
     # which beats JAX_PLATFORMS — the subprocess must re-pin in-process
     # (force_platform) or it dials the device tunnel anyway.
-    pin = "force_platform('cpu', device_count={workers})" if pin_cpu else "pass"
+    pin = f"force_platform('cpu', device_count={workers})" if pin_cpu else "pass"
     code = f"""
 import json, time, numpy as np
 from deepreduce_tpu.utils import force_platform
@@ -456,7 +456,9 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — headline must still print
             _progress(f"measured exchange failed: {e}")
 
-    if not quick and "--skip-models" not in sys.argv:
+    if not quick and not degraded and "--skip-models" not in sys.argv:
+        # (CPU-degraded runs skip this: img/s and MFU of a conv net on the
+        # host CPU say nothing about the chip-level north-star metric)
         # ResNet-50/20 images/sec + MFU at topk 1% (BASELINE.md north-star
         # metric): full fwd+bwd+compressed-exchange steps on the real chip.
         # The persistent compile cache makes repeat runs fast.

@@ -483,8 +483,8 @@ class IntegerNativeCodec(Codec):
                 f"unknown integer codec {self.code!r}; have {native.INT_CODEC_NAMES}"
             )
         # static budget: the family-wide worst case (b=32 pfor blocks /
-        # 5-byte varints), matching int_codec_from_name's encode cap
-        self.budget_words = 2 * k + 2 * ((k + 127) // 128) + 16
+        # 5-byte varints) — the shared sizing formula
+        self.budget_words = native.int_cap_words(k)
 
     def encode(self, sp, dense=None, *, step=0, key=None):
         import numpy as np

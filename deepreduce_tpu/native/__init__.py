@@ -232,6 +232,13 @@ def pfor_decode(words: np.ndarray, n_max: int) -> np.ndarray:
 INT_CODEC_NAMES = ("fbp", "varint", "pfor")
 
 
+def int_cap_words(k: int) -> int:
+    """Family-wide worst-case wire size in words for k values: every pfor
+    block at base width 32 (header + full words) / 5-byte varints, plus
+    headers. The single sizing formula for every encode entry point."""
+    return 2 * k + 2 * ((k + 127) // 128) + 16
+
+
 def int_codec_from_name(name: str):
     """(encode, decode) for a named integer-codec family member — the
     CODECFactory::getFromName role (/root/reference/tensorflow/
@@ -244,7 +251,7 @@ def int_codec_from_name(name: str):
 
     def enc(sorted_vals: np.ndarray) -> np.ndarray:
         v = np.ascontiguousarray(sorted_vals, np.uint32)
-        cap = 2 * len(v) + 2 * ((len(v) + 127) // 128) + 16
+        cap = int_cap_words(len(v))
         out = np.zeros(cap, np.uint32)
         n = lib.drn_int_encode_named(
             cname, _ptr(v, ctypes.c_uint32), len(v), _ptr(out, ctypes.c_uint32), cap

@@ -4,15 +4,20 @@
 // FFI so they appear as custom-calls inside jitted programs on the CPU
 // platform (TPU host offload goes through the same registry).
 //
-// Handlers:
+// Handlers (decode side AND encode side — both directions are
+// custom-calls, like the reference's paired Compressor/Decompressor ops):
 //   drn_ffi_bloom_query   (bitmap u8[m_bytes], h) -> mask u8[d]
+//   drn_ffi_bloom_insert  (indices i32[k], h) -> bitmap u8[m_bytes]
 //   drn_ffi_fbp_decode    (words u32[n]) -> values u32[cap]  (delta-unpacked)
 //   drn_ffi_varint_decode (bytes u8[n])  -> values u32[cap]
+//   drn_ffi_int_encode    (vals u32[k], count i32[1], code) ->
+//                         (words u32[cap], nwords i32[1])   (name-keyed)
 //
 // Build: make -C deepreduce_tpu/native xla (adds -I jaxlib/include).
 
 #include <cstdint>
 #include <cstring>
+#include <string>
 
 #include "xla/ffi/api/ffi.h"
 
@@ -20,9 +25,11 @@ namespace ffi = xla::ffi;
 
 // from deepreduce_native.cc
 extern "C" {
+void drn_bloom_insert(const int32_t*, int32_t, int32_t, int32_t, uint8_t*);
 int32_t drn_bloom_query_universe(const uint8_t*, int32_t, int32_t, int32_t, uint8_t*);
 int32_t drn_fbp_decode(const uint32_t*, int32_t, uint32_t*, int32_t);
 int32_t drn_varint_decode(const uint8_t*, int32_t, uint32_t*, int32_t);
+int32_t drn_int_encode_named(const char*, const uint32_t*, int32_t, uint32_t*, int32_t);
 }
 
 static ffi::Error BloomQueryImpl(ffi::Buffer<ffi::U8> bitmap, int64_t num_hash,
@@ -67,3 +74,48 @@ static ffi::Error VarintDecodeImpl(ffi::Buffer<ffi::U8> bytes,
 XLA_FFI_DEFINE_HANDLER_SYMBOL(
     DrnVarintDecode, VarintDecodeImpl,
     ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::U8>>().Ret<ffi::Buffer<ffi::U32>>());
+
+static ffi::Error BloomInsertImpl(ffi::Buffer<ffi::S32> indices,
+                                  int64_t num_hash,
+                                  ffi::ResultBuffer<ffi::U8> bitmap) {
+  int32_t m_bits = (int32_t)bitmap->element_count() * 8;
+  std::memset(bitmap->typed_data(), 0, bitmap->element_count());
+  drn_bloom_insert(indices.typed_data(), (int32_t)indices.element_count(),
+                   m_bits, (int32_t)num_hash, bitmap->typed_data());
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    DrnBloomInsert, BloomInsertImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Attr<int64_t>("num_hash")
+        .Ret<ffi::Buffer<ffi::U8>>());
+
+static ffi::Error IntEncodeImpl(ffi::Buffer<ffi::U32> vals,
+                                ffi::Buffer<ffi::S32> count,
+                                std::string_view code,
+                                ffi::ResultBuffer<ffi::U32> words,
+                                ffi::ResultBuffer<ffi::S32> nwords) {
+  int32_t cap = (int32_t)words->element_count();
+  std::memset(words->typed_data(), 0, (size_t)cap * 4);
+  int32_t n = count.typed_data()[0];
+  if (n < 0 || n > (int32_t)vals.element_count())
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "bad live count");
+  std::string code_s(code);
+  int32_t w = drn_int_encode_named(code_s.c_str(), vals.typed_data(), n,
+                                   words->typed_data(), cap);
+  if (w < 0)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "int encode failed");
+  nwords->typed_data()[0] = w;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    DrnIntEncode, IntEncodeImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::U32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Attr<std::string_view>("code")
+        .Ret<ffi::Buffer<ffi::U32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());

@@ -41,8 +41,10 @@ def register() -> None:
     lib = ctypes.CDLL(str(_LIB))
     for name, sym in [
         ("drn_bloom_query", "DrnBloomQuery"),
+        ("drn_bloom_insert", "DrnBloomInsert"),
         ("drn_fbp_decode", "DrnFbpDecode"),
         ("drn_varint_decode", "DrnVarintDecode"),
+        ("drn_int_encode", "DrnIntEncode"),
     ]:
         jax.ffi.register_ffi_target(name, jax.ffi.pycapsule(getattr(lib, sym)), platform="cpu")
     _registered = True
@@ -65,3 +67,32 @@ def fbp_decode(words: jax.Array, n: int) -> jax.Array:
 def varint_decode(data: jax.Array, n: int) -> jax.Array:
     register()
     return jax.ffi.ffi_call("drn_varint_decode", jax.ShapeDtypeStruct((n,), jnp.uint32))(data)
+
+
+def bloom_insert(indices: jax.Array, m_bits: int, num_hash: int) -> jax.Array:
+    """i32[k] indices -> uint8[m_bits/8] filter bitmap, as an XLA custom
+    call — the encode-side counterpart of `bloom_query` (the reference's
+    BloomCompressorOp insert loop, bloom_filter_compression.cc:102-105).
+    Takes m_bits like its ctypes twin `native.bloom_insert`, so the two
+    APIs are drop-in interchangeable. Dead slots should be pre-pointed at
+    a live index (duplicate inserts are no-ops under bloom set
+    semantics)."""
+    register()
+    return jax.ffi.ffi_call(
+        "drn_bloom_insert", jax.ShapeDtypeStruct((m_bits // 8,), jnp.uint8)
+    )(indices.astype(jnp.int32), num_hash=np.int64(num_hash))
+
+
+def int_encode(vals: jax.Array, count: jax.Array, code: str, cap_words: int):
+    """(u32[k] sorted values, i32[] live count) -> (u32[cap] wire words,
+    i32[] live words) via the name-keyed integer-codec family
+    (CODECFactory::getFromName role) as an XLA custom call."""
+    register()
+    words, nwords = jax.ffi.ffi_call(
+        "drn_int_encode",
+        (
+            jax.ShapeDtypeStruct((cap_words,), jnp.uint32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ),
+    )(vals.astype(jnp.uint32), count.reshape(1).astype(jnp.int32), code=code)
+    return words, nwords[0]

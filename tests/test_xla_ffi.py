@@ -38,3 +38,44 @@ def test_bloom_query_custom_call_matches_ctypes_and_jax():
     words = bloom.insert(jnp.asarray(idx), jnp.asarray(k), meta)
     jax_mask = np.asarray(bloom.query_universe(words, meta)).astype(np.uint8)
     np.testing.assert_array_equal(np.asarray(ffi_mask), jax_mask)
+
+
+def test_ffi_bloom_insert_matches_ctypes():
+    """Encode-side custom call: the FFI insert builds the byte-identical
+    bitmap to the ctypes host path (same murmur mix, same bit order)."""
+    xla_ops = pytest.importorskip("deepreduce_tpu.native.xla_ops")
+    native = pytest.importorskip("deepreduce_tpu.native")
+    try:
+        xla_ops.register()
+    except Exception as e:  # build/toolchain unavailable
+        pytest.skip(f"ffi unavailable: {e}")
+    rng = np.random.default_rng(5)
+    k, m_bits, h = 500, 1 << 14, 5
+    idx = np.sort(rng.choice(100_000, k, replace=False)).astype(np.int32)
+    via_ffi = np.asarray(
+        jax.jit(lambda i: xla_ops.bloom_insert(i, m_bits, h))(jnp.asarray(idx))
+    )
+    via_ctypes = native.bloom_insert(idx, m_bits, h)
+    np.testing.assert_array_equal(via_ffi, np.asarray(via_ctypes))
+
+
+@pytest.mark.parametrize("code", ["fbp", "varint", "pfor"])
+def test_ffi_int_encode_round_trips_against_host_decode(code):
+    """Name-keyed encode as an XLA custom call; host decode recovers the
+    exact sorted indices for every family member."""
+    xla_ops = pytest.importorskip("deepreduce_tpu.native.xla_ops")
+    native = pytest.importorskip("deepreduce_tpu.native")
+    try:
+        xla_ops.register()
+    except Exception as e:
+        pytest.skip(f"ffi unavailable: {e}")
+    rng = np.random.default_rng(6)
+    k = 3000
+    idx = np.sort(rng.choice(500_000, k, replace=False)).astype(np.uint32)
+    cap = native.int_cap_words(k)
+    words, nwords = jax.jit(
+        lambda v, c: xla_ops.int_encode(v, c, code, cap)
+    )(jnp.asarray(idx), jnp.asarray(k, jnp.int32))
+    _, dec = native.int_codec_from_name(code)
+    out = dec(np.asarray(words)[: int(nwords)], k)
+    np.testing.assert_array_equal(out, idx)

@@ -438,26 +438,32 @@ def main() -> None:
         },
     }
     if not quick:
-        # ResNet-50-scale codec timings (the BASELINE.json north-star size)
-        r50 = measure_config(
-            RESNET50_D,
-            0.01,
-            dict(deepreduce="both", index="integer", value="qsgd", policy="p0", memory="none"),
-            overhead,
-            3,
-        )
-        detail["resnet50_drqsgd_delta"] = {
-            "rel_volume": round(r50["rel_volume"], 5),
-            "t_encode_s": round(r50["t_encode_s"], 4),
-            "t_decode_s": round(r50["t_decode_s"], 4),
-            # effective gradient-exchange bandwidth: dense bytes made
-            # exchangeable per second of codec work (the BASELINE.md
-            # north-star framing)
-            "effective_exchange_GBps": round(
-                4.0 * RESNET50_D / max(r50["t_encode_s"] + r50["t_decode_s"], 1e-9) / 1e9,
-                2,
+        # ResNet-50-scale codec timings (the BASELINE.json north-star size):
+        # the fastest config (delta) AND the paper's flagship (bloom P0)
+        for rname, rkw in {
+            "resnet50_drqsgd_delta": dict(
+                deepreduce="both", index="integer", value="qsgd", policy="p0",
+                memory="none",
             ),
-        }
+            "resnet50_drqsgd_bloom": dict(
+                deepreduce="both", index="bloom", value="qsgd", policy="p0",
+                fpr=0.001, memory="none",
+            ),
+        }.items():
+            r50 = measure_config(RESNET50_D, 0.01, rkw, overhead, 3)
+            detail[rname] = {
+                "rel_volume": round(r50["rel_volume"], 5),
+                "t_encode_s": round(r50["t_encode_s"], 4),
+                "t_decode_s": round(r50["t_decode_s"], 4),
+                # effective gradient-exchange bandwidth: dense bytes made
+                # exchangeable per second of codec work (the BASELINE.md
+                # north-star framing)
+                "effective_exchange_GBps": round(
+                    4.0 * RESNET50_D
+                    / max(r50["t_encode_s"] + r50["t_decode_s"], 1e-9) / 1e9,
+                    2,
+                ),
+            }
 
     if not quick:
         # OBSERVED exchange throughput next to the analytic model above

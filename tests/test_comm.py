@@ -127,11 +127,14 @@ def test_payload_bytes_static_accounting():
     [
         dict(deepreduce=None, compress_ratio=0.05),
         dict(deepreduce="index", index="bloom", compress_ratio=0.02, fpr=0.01),
+        dict(deepreduce="both", index="bloom", value="qsgd", policy="p0",
+             compress_ratio=0.05, fpr=0.05, bloom_blocked="mod"),
         dict(deepreduce="both", index="integer", value="qsgd", policy="p0",
              compress_ratio=0.05),
         dict(deepreduce="value", value="polyfit", compress_ratio=0.05),
     ],
-    ids=["topr", "bloom-index", "integer-qsgd-both", "polyfit-value"],
+    ids=["topr", "bloom-index", "modbloom-qsgd-both", "integer-qsgd-both",
+         "polyfit-value"],
 )
 def test_fused_matches_per_tensor(codec_cfg):
     """The fused one-buffer exchange is bit-identical to the reference-shaped

@@ -323,3 +323,22 @@ def test_both_bloom_p0_round_trip():
     assert err.max() < 1.0, err.max()
     assert np.corrcoef(out[sel], np.asarray(g)[sel])[0, 1] > 0.95
     assert (out != 0).sum() >= int(sp.nnz)
+
+
+def test_prefix_positions_edge_cases():
+    """Rank inversion must agree with np.nonzero on degenerate masks:
+    empty, full, single positive at each boundary, budget=1."""
+    pp = jax.jit(bloom._prefix_positions, static_argnums=1)
+    for d in (31, 32, 33, 1000):
+        for mask_np in (
+            np.zeros(d, bool),
+            np.ones(d, bool),
+            np.eye(1, d, 0, dtype=bool)[0],      # only j=0
+            np.eye(1, d, d - 1, dtype=bool)[0],  # only j=d-1
+        ):
+            for budget in (1, 7, d):
+                pos, count = pp(jnp.asarray(mask_np), budget)
+                want = np.nonzero(mask_np)[0][:budget]
+                n = len(want)
+                assert int(count) == min(int(mask_np.sum()), budget)
+                np.testing.assert_array_equal(np.asarray(pos)[:n], want)

@@ -113,8 +113,8 @@ class GradientExchanger:
     ):
         self.cfg = cfg
         self.axis_name = axis_name
-        # static mesh-axis size; required only by communicator='qar' (its
-        # all_to_all reshape needs a static worker count)
+        # static mesh-axis size; required by the communicators built on
+        # all_to_all ('qar', 'sparse_rs') whose reshapes need it
         self.num_workers = num_workers
         if cfg.communicator == "qar" and (
             cfg.deepreduce is not None
@@ -129,6 +129,18 @@ class GradientExchanger:
                 f"/ memory={cfg.memory!r} would be silently ignored — use "
                 "compressor='none', deepreduce=None, memory='none' (or a "
                 "different communicator)"
+            )
+        if cfg.communicator == "sparse_rs" and (
+            cfg.deepreduce is not None or cfg.compressor != "topk"
+        ):
+            raise ValueError(
+                "communicator='sparse_rs' top-k-sparsifies and routes "
+                "entries itself (sparse_rs.py); a deepreduce codec stack or "
+                "a different sparsifier would be silently ignored — got "
+                f"deepreduce={cfg.deepreduce!r}, compressor={cfg.compressor!r}. "
+                "Use compressor='topk', deepreduce=None (compression comes "
+                "from the top-k + sharded re-selection), or the allgather "
+                "communicator for codec-compressed payloads."
             )
         leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
         self.names = [_leaf_name(path) for path, _ in leaves]
@@ -179,6 +191,8 @@ class GradientExchanger:
 
         if cfg.communicator == "qar":
             return self._exchange_qar(grads, state, step=step, key=key)
+        if cfg.communicator == "sparse_rs":
+            return self._exchange_sparse_rs(grads, state, step=step, key=key)
 
         if cfg.communicator == "allreduce" or cfg.deepreduce is None and cfg.compressor == "none":
             # dense baseline: NCCL allreduce -> psum (run_deepreduce.sh:51)
@@ -315,6 +329,43 @@ class GradientExchanger:
         own_leaves = dict(zip(self.names, own_fin)) if need_own else {}
         return agg_leaves, own_leaves
 
+    def _exchange_sparse_rs(
+        self, grads: Any, state: Any, *, step: jax.Array, key: Optional[jax.Array]
+    ) -> Tuple[Any, Any, WireStats]:
+        """Sparse reduce-scatter + allgather (sparse_rs.py — the Ok-Topk /
+        SparCML collective shape): top-k entries routed to shard owners via
+        all_to_all, reduced densely per shard, re-selected, allgathered.
+        Per-worker decode is O(k) instead of the allgather path's O(W·k).
+        Residual error feedback covers phase-1 (send-side) truncation."""
+        from deepreduce_tpu import sparse_rs
+        from jax.flatten_util import ravel_pytree
+
+        cfg = self.cfg
+        if self.num_workers is None:
+            raise ValueError(
+                "communicator='sparse_rs' needs the static mesh size: "
+                "construct GradientExchanger(..., num_workers=mesh.shape[axis])"
+            )
+        compensated = grads
+        if state is not None:
+            compensated = memory.compensate(grads, state, beta=cfg.beta, gamma=cfg.gamma)
+        flat, unravel = ravel_pytree(compensated)
+        mean, own_flat, stats = sparse_rs.exchange(
+            flat.astype(jnp.float32),
+            self.axis_name,
+            self.num_workers,
+            ratio=cfg.compress_ratio,
+            approx_topk=cfg.approx_topk,
+            headroom=cfg.rs_headroom,
+            out_headroom=cfg.rs_out_headroom,
+        )
+        agg = unravel(mean.astype(flat.dtype))
+        new_state = state
+        if state is not None:
+            own = unravel(own_flat.astype(flat.dtype))
+            new_state = memory.update(compensated, own)
+        return agg, new_state, stats
+
     def _exchange_qar(
         self, grads: Any, state: Any, *, step: jax.Array, key: Optional[jax.Array]
     ) -> Tuple[Any, Any, WireStats]:
@@ -386,6 +437,21 @@ class GradientExchanger:
             return int(
                 qar.wire_bits_per_worker(d, self.num_workers, self.cfg.bucket_size) // 8
             )
+        if self.cfg.communicator == "sparse_rs":
+            from deepreduce_tpu import sparse_rs
+
+            d = sum(
+                int(math.prod(l.shape)) if l.shape else 1
+                for l in jax.tree_util.tree_leaves(grads_like)
+            )
+            if self.num_workers is None:
+                raise ValueError("sparse_rs payload accounting needs num_workers")
+            W = self.num_workers
+            b = sparse_rs.send_budget(d, self.cfg.compress_ratio, W, self.cfg.rs_headroom)
+            k2 = sparse_rs.out_budget(
+                d, self.cfg.compress_ratio, W, self.cfg.rs_out_headroom
+            )
+            return (W * b + k2) * 8  # f32 value + i32 index per entry
         total = 0
         flat = dict(zip(self.names, jax.tree_util.tree_leaves(grads_like)))
         for name, codec in self.codecs.items():

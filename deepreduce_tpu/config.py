@@ -28,7 +28,9 @@ class DeepReduceConfig:
     # collective (GRACE 'communicator' role). 'qar' = int8 quantized
     # reduce-scatter+allgather (qar.py) — a TPU-native third shape beyond
     # the reference's two
-    communicator: str = "allgather"  # allgather | allreduce | qar
+    # 'sparse_rs' = sparse reduce-scatter+allgather (sparse_rs.py, the
+    # Ok-Topk/SparCML shape): O(k) per-worker decode vs allgather's O(W*k)
+    communicator: str = "allgather"  # allgather | allreduce | qar | sparse_rs
     # DeepReduce wrapper mode (README.md:31-35)
     deepreduce: Optional[str] = None  # None | 'value' | 'index' | 'both'
     value: str = "polyfit"  # polyfit | doubleexp | qsgd | gzip
@@ -51,6 +53,13 @@ class DeepReduceConfig:
     bucket_size: int = 512
     sort: bool = False
     seed: int = 0
+    # sparse_rs phase-1 per-shard budget multiplier over the expected k/W
+    # occupancy; overflow mass stays in the sender's residual
+    rs_headroom: float = 2.0
+    # sparse_rs phase-2 output budget multiplier: 1.0 = the Ok-Topk
+    # output-volume convention (k entries total); raise to trade wire bytes
+    # for coverage of shard-occupancy fluctuations
+    rs_out_headroom: float = 1.0
     use_pallas: bool = False  # pallas TPU kernels where applicable (QSGD PRNG)
     # fuse the whole pytree's payloads into ONE uint8 buffer per step and
     # run a single all_gather + one worker-decode loop, instead of one

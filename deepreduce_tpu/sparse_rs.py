@@ -1,0 +1,171 @@
+"""Sparse reduce-scatter + allgather — the Ok-Topk / SparCML exchange shape.
+
+The allgather communicator (the reference's only compressed collective,
+README.md:37) makes every worker decode every peer's payload: O(W·k) decode
+work and W·k wire entries per worker. The sparse-allreduce literature
+(PAPERS.md: "Near-Optimal Sparse Allreduce" (Ok-Topk), SparCML, S2 Reducer)
+splits the universe into W contiguous shards instead:
+
+    phase 1 (sparse reduce-scatter): each worker routes its top-k entries
+        to the shard-owner via `all_to_all` (static per-shard budget,
+        largest-|v| kept on overflow — the dropped mass stays in the
+        sender's residual by construction); the owner scatter-adds the W
+        received slices into a dense shard buffer.
+    phase 2 (sparse allgather): the owner re-selects the top k/W of its
+        *reduced* shard and `all_gather`s (values, global indices); every
+        worker scatters W small payloads into the dense result.
+
+Per-worker wire ~ k·headroom + k entries vs the allgather path's W·k, and
+decode is O(k) instead of O(W·k) — the gap grows with the mesh. The phase-2
+re-selection is lossy (Ok-Topk §4 accepts the same truncation; its mass is
+bounded by the per-shard budget) while phase-1 truncation is error-fed back
+like any sparsifier.
+
+All static-shape: budgets derive from (d, ratio, W) at trace time; live
+counts ride in-band. Runs inside shard_map over the data axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu import sparse
+from deepreduce_tpu.metrics import WireStats
+
+
+def shard_size(d: int, num_workers: int) -> int:
+    return (d + num_workers - 1) // num_workers
+
+
+def send_budget(d: int, ratio: float, num_workers: int, headroom: float) -> int:
+    """Per-shard slots in the phase-1 all_to_all: expected k/W occupancy
+    times headroom (top-k positions are ~uniform over shards; Poisson
+    fluctuation at k/W ~ thousands is a few percent, so a modest headroom
+    captures nearly all mass — what overflows stays in the residual)."""
+    k = sparse.num_slots(d, ratio)
+    return max(1, int(math.ceil(k / num_workers * headroom)))
+
+
+def out_budget(
+    d: int, ratio: float, num_workers: int, headroom: float = 1.0
+) -> int:
+    """Phase-2 slots per shard: k/W (total across shards == k — the
+    Ok-Topk output-volume convention) times an optional headroom, capped
+    at the shard size."""
+    k = sparse.num_slots(d, ratio)
+    b = max(1, int(math.ceil(k / num_workers * headroom)))
+    return min(b, shard_size(d, num_workers))
+
+
+def exchange(
+    flat: jax.Array,
+    axis_name: str,
+    num_workers: int,
+    *,
+    ratio: float,
+    approx_topk: bool = False,
+    headroom: float = 2.0,
+    out_headroom: float = 1.0,
+) -> Tuple[jax.Array, jax.Array, WireStats]:
+    """-> (mean gradient f32[d], own-transmitted dense f32[d] for error
+    feedback, wire stats). Call inside shard_map over `axis_name`."""
+    d = flat.shape[0]
+    W = num_workers
+    S = shard_size(d, W)
+    B = send_budget(d, ratio, W, headroom)
+    K2 = out_budget(d, ratio, W, out_headroom)
+
+    # sort_indices=False keeps lax.top_k's descending-|v| order — the
+    # overflow-drop-smallest property below depends on it
+    sp = sparse.topk(flat, ratio, sort_indices=False, approx=approx_topk)
+    k = sp.k
+
+    # --- phase 1: route entries to their shard-owners ------------------- #
+    live = jnp.arange(k, dtype=jnp.int32) < sp.nnz
+    shard_of = jnp.where(live, sp.indices // S, W)  # dead -> parked shard W
+    # stable sort by shard keeps lax.top_k's descending-|v| order within
+    # each shard, so budget overflow drops the smallest magnitudes
+    order = jnp.argsort(shard_of, stable=True)
+    sh = shard_of[order]
+    vals = sp.values[order]
+    idxs = sp.indices[order]
+    # per-shard rank = position within my shard's run
+    pos = jnp.arange(k, dtype=jnp.int32)
+    first_of_run = jnp.where(
+        jnp.concatenate([jnp.ones((1,), bool), sh[1:] != sh[:-1]]), pos, -1
+    )
+    run_start = jax.lax.cummax(first_of_run)
+    rank = pos - run_start
+    keep = jnp.logical_and(sh < W, rank < B)
+    # scatter into the [W, B] send matrix (unique targets by construction)
+    tgt = jnp.where(keep, sh * B + rank, W * B + pos)
+    send_v = (
+        jnp.zeros((W * B,), flat.dtype)
+        .at[tgt].set(vals, mode="drop", unique_indices=True)
+        .reshape(W, B)
+    )
+    # local index within the shard; dead slots point at 0 with value 0
+    send_i = (
+        jnp.zeros((W * B,), jnp.int32)
+        .at[tgt].set(idxs - sh * S, mode="drop", unique_indices=True)
+        .reshape(W, B)
+    )
+    # ONE collective per phase: ride the indices next to the values as
+    # bitcast f32 lanes in the same buffer (the fused-allgather pattern)
+    send_buf = jnp.concatenate(
+        [send_v.astype(jnp.float32),
+         jax.lax.bitcast_convert_type(send_i, jnp.float32)], axis=1
+    )  # [W, 2B]
+    rx = jax.lax.all_to_all(send_buf, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    rx_v = rx[:, :B]
+    rx_i = jax.lax.bitcast_convert_type(rx[:, B:], jnp.int32)
+
+    # --- reduce my shard ------------------------------------------------- #
+    shard_buf = jnp.zeros((S,), jnp.float32).at[rx_i.reshape(-1)].add(
+        rx_v.reshape(-1).astype(jnp.float32)
+    )
+    # zero-value dead slots all land on local index 0: adding 0 is exact
+
+    # --- phase 2: re-select the reduced shard and allgather -------------- #
+    widx = jax.lax.axis_index(axis_name)
+    mag = jnp.abs(shard_buf)
+    top_v, top_i = jax.lax.top_k(mag, K2)
+    out_vals = shard_buf[top_i]
+    out_idx = (top_i + widx * S).astype(jnp.int32)
+    out_buf = jnp.concatenate(
+        [out_vals.astype(jnp.float32),
+         jax.lax.bitcast_convert_type(out_idx, jnp.float32)]
+    )  # [2*K2]
+    gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
+    gathered_v = gathered[:, :K2]
+    gathered_i = jax.lax.bitcast_convert_type(gathered[:, K2:], jnp.int32)
+    dense = (
+        jnp.zeros((W * S,), jnp.float32)
+        .at[jnp.clip(gathered_i.reshape(-1), 0, W * S - 1)]
+        .add(gathered_v.reshape(-1))[:d]
+    )
+    # indices are globally unique (each worker owns a disjoint shard and
+    # top_k returns distinct positions), so add == set; mean over workers
+    mean = dense / W
+
+    # own-transmitted mass (what actually left this worker, phase-1
+    # truncation applied) for residual error feedback; dead/overflow slots
+    # park at unique out-of-range targets
+    own_dense = (
+        jnp.zeros((W * S,), flat.dtype)
+        .at[jnp.where(keep, idxs, W * S + pos)]
+        .set(vals, mode="drop", unique_indices=True)[:d]
+    )
+
+    # wire accounting: every transmitted entry is an f32 value + i32 index
+    # (phase 1: W*B slots out per worker; phase 2: K2 slots gathered out)
+    stats = WireStats(
+        index_bits=jnp.asarray((W * B + K2) * 32.0, jnp.float32),
+        value_bits=jnp.asarray((W * B + K2) * 32.0, jnp.float32),
+        dense_bits=jnp.asarray(d * 32.0, jnp.float32),
+    )
+    return mean.astype(flat.dtype), own_dense, stats

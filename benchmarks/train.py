@@ -63,6 +63,13 @@ def _mobilenet():
     return MobileNetV1(), ("image", (32, 32, 3), 10)
 
 
+@_register("vgg16")
+def _vgg16():
+    from deepreduce_tpu.models import VGG16
+
+    return VGG16(), ("image", (32, 32, 3), 10)
+
+
 @_register("resnet50")
 def _resnet50():
     from deepreduce_tpu.models import ResNet50

@@ -3,6 +3,7 @@
 - ResNet-20 / CIFAR-10 and ResNet-50 / ImageNet (paper Table 1)
 - DenseNet40-K12 / CIFAR-10 (paper Table 1)
 - MobileNet / CIFAR-10 (paper Table 5, FL testbed)
+- VGG16 (third family in PolySeg's per-model tables, tensorflow/deepreduce.py:182-219)
 - NCF / MovieLens-20M (paper Table 1/6 — the natively-sparse config)
 - LSTM / StackOverflow next-word (paper Table 1/2, FedAvg testbed)
 - BERT-base encoder (BASELINE.json config 5 — the new ICI stress test)
@@ -17,12 +18,14 @@ from deepreduce_tpu.models.lstm import WordLSTM
 from deepreduce_tpu.models.mobilenet import MobileNetV1
 from deepreduce_tpu.models.ncf import NeuMF
 from deepreduce_tpu.models.resnet import ResNet20, ResNet50
+from deepreduce_tpu.models.vgg import VGG16
 
 __all__ = [
     "ResNet20",
     "ResNet50",
     "DenseNet40",
     "MobileNetV1",
+    "VGG16",
     "NeuMF",
     "WordLSTM",
     "BertEncoder",

@@ -1,0 +1,41 @@
+"""VGG16 — the third model family the reference's PolySeg codec carries
+per-model segment tables for (/root/reference/tensorflow/deepreduce.py:
+182-219 `get_breaks` keys resnet20_v2 / vgg16 / resnet50; :244-253
+`get_num_of_segments`). CIFAR-sized variant (conv stacks + GAP head) so the
+polyseg conv-whitelist path has its reference-named third target."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class VGG16(nn.Module):
+    num_classes: int = 10
+    # (filters, convs) per stage, max-pooled between stages — the standard
+    # 13-conv VGG16 configuration "D"
+    stages: Sequence[Tuple[int, int]] = (
+        (64, 2),
+        (128, 2),
+        (256, 3),
+        (512, 3),
+        (512, 3),
+    )
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for filters, convs in self.stages:
+            for _ in range(convs):
+                x = nn.Conv(filters, (3, 3), use_bias=False, dtype=self.dtype)(x)
+                x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(512, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,44 @@
+"""Model-zoo smoke tests: every image family initializes and produces
+logits of the right shape on a tiny input (the reference exercises its
+models only through full benchmark runs; this is the cheap CI-able slice).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+
+@pytest.mark.parametrize(
+    "name,num_classes",
+    [("ResNet20", 10), ("DenseNet40", 10), ("MobileNetV1", 10), ("VGG16", 10)],
+)
+def test_image_model_forward(name, num_classes):
+    import deepreduce_tpu.models as zoo
+
+    model = getattr(zoo, name)()
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, num_classes)
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))
+    assert n_params > 10_000
+
+
+def test_vgg16_conv_layer_names_match_polyseg_whitelist():
+    """The polyseg conv-pattern default (r'(?i)conv') must hit VGG16's conv
+    kernels — the reference keys its per-model tables by conv layers
+    (tensorflow/deepreduce.py:230-242 is_convolutional)."""
+    import re
+
+    import deepreduce_tpu.models as zoo
+
+    model = zoo.VGG16()
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    flat = jax.tree_util.tree_leaves_with_path(variables["params"])
+    conv_kernels = [
+        jax.tree_util.keystr(path)
+        for path, leaf in flat
+        if re.search(r"(?i)conv", jax.tree_util.keystr(path)) and leaf.ndim == 4
+    ]
+    assert len(conv_kernels) == 13  # VGG16 configuration "D"

@@ -418,11 +418,17 @@ def _prefix_positions(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Arra
     p_ex = cs - cnt
     count = jnp.minimum(cs[-1], budget)
     markers = (
-        jnp.zeros((budget + 1,), jnp.int32).at[jnp.minimum(p_ex, budget)].add(1)
+        jnp.zeros((budget + 1,), jnp.int32)
+        .at[jnp.minimum(p_ex, budget)]
+        .add(1, indices_are_sorted=True)
     )
     g_of_s = jnp.clip(jnp.cumsum(markers)[:budget] - 1, 0, g_count - 1)
-    t = jnp.arange(budget, dtype=jnp.int32) - p_ex[g_of_s]
-    b = _select_bit(hw[g_of_s], t)
+    # g_of_s is non-decreasing by construction (cumsum of non-negative
+    # markers) — sorted gathers let XLA:TPU walk HBM sequentially
+    t = jnp.arange(budget, dtype=jnp.int32) - jnp.take(
+        p_ex, g_of_s, indices_are_sorted=True
+    )
+    b = _select_bit(jnp.take(hw, g_of_s, indices_are_sorted=True), t)
     pos = jnp.clip(g_of_s * 32 + b, 0, d - 1)
     return pos, count
 
@@ -482,7 +488,13 @@ def encode(
         mask = query_universe(words, meta)
         pos, nsel = _prefix_positions(mask, meta.budget)
         live = jnp.arange(meta.budget, dtype=jnp.int32) < nsel
-        values = jnp.where(live, flat[pos], jnp.zeros((), flat.dtype))
+        # pos is ascending (rank order): a sorted gather for the FP-aware
+        # value re-read
+        values = jnp.where(
+            live,
+            jnp.take(flat, pos, indices_are_sorted=True),
+            jnp.zeros((), flat.dtype),
+        )
     elif dense is not None:
         mask = query_universe(words, meta)
         selected, nsel = select(mask, meta, step=step, seed=seed)
@@ -566,12 +578,13 @@ def decode_dense(
     nsel = jnp.minimum(nsel, n_v)
     live = jnp.arange(meta.budget, dtype=jnp.int32) < nsel
     # dead slots park at unique out-of-range targets so mode='drop' discards
-    # them without breaking the unique-indices promise
+    # them without breaking the unique-indices promise; live pos is ascending
+    # and parked targets (d + s > any pos) keep the whole stream sorted
     tgt = jnp.where(live, pos, d + jnp.arange(meta.budget, dtype=jnp.int32))
     dense = (
         jnp.zeros((d,), vals.dtype)
         .at[tgt]
-        .set(vals, mode="drop", unique_indices=True)
+        .set(vals, mode="drop", unique_indices=True, indices_are_sorted=True)
     )
     return dense.reshape(shape)
 

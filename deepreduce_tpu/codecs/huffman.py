@@ -140,6 +140,8 @@ def _decode_host(stream: np.ndarray, nbits: int, n_syms: int, d: int) -> np.ndar
     decoding is the orbit of position 0 under that successor map. Doubling
     the known prefix of the orbit log2(n_syms) times extracts all symbol
     boundaries with O(n log n) numpy gathers and no Python-per-symbol work."""
+    if n_syms == 0:
+        return np.zeros(0, np.uint8)
     lut_sym, lut_len, max_len = _decode_lut(d)
     bits = np.unpackbits(stream)[:nbits]
     padded = np.concatenate([bits, np.zeros(max_len, np.uint8)])

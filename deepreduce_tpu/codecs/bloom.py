@@ -45,6 +45,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from deepreduce_tpu import sparse as _sparse
 from deepreduce_tpu.sparse import SparseGrad
 
 _LN2 = 0.6931471805599453
@@ -426,9 +427,9 @@ def _prefix_positions(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Arra
     # g_of_s is non-decreasing by construction (cumsum of non-negative
     # markers) — sorted gathers let XLA:TPU walk HBM sequentially
     t = jnp.arange(budget, dtype=jnp.int32) - jnp.take(
-        p_ex, g_of_s, indices_are_sorted=True
+        p_ex, g_of_s, indices_are_sorted=True, mode="clip"
     )
-    b = _select_bit(jnp.take(hw, g_of_s, indices_are_sorted=True), t)
+    b = _select_bit(jnp.take(hw, g_of_s, indices_are_sorted=True, mode="clip"), t)
     pos = jnp.clip(g_of_s * 32 + b, 0, d - 1)
     return pos, count
 
@@ -492,7 +493,7 @@ def encode(
         # value re-read
         values = jnp.where(
             live,
-            jnp.take(flat, pos, indices_are_sorted=True),
+            jnp.take(flat, pos, indices_are_sorted=True, mode="clip"),
             jnp.zeros((), flat.dtype),
         )
     elif dense is not None:
@@ -563,30 +564,13 @@ def decode_dense(
             sp = dataclasses.replace(sp, values=values)
         return sp.to_dense()
     vals = payload.values if values is None else values
-    d = meta.d
-    # tolerate value tables shorter/longer than the budget ('both' mode can
-    # hand in a k-length table while p0's budget exceeds k): pad with zeros
-    # and never read past the table's live length
     n_v = vals.shape[0]
-    if n_v < meta.budget:
-        vals = jnp.zeros((meta.budget,), vals.dtype).at[:n_v].set(vals)
-    else:
-        vals = vals[: meta.budget]
+    vals = _sparse.fit_length(vals, meta.budget)
     mask = query_universe(payload.words, meta)
     pos, derived = _prefix_positions(mask, meta.budget)
     nsel = jnp.minimum(jnp.minimum(payload.nsel, meta.budget), derived)
     nsel = jnp.minimum(nsel, n_v)
-    live = jnp.arange(meta.budget, dtype=jnp.int32) < nsel
-    # dead slots park at unique out-of-range targets so mode='drop' discards
-    # them without breaking the unique-indices promise; live pos is ascending
-    # and parked targets (d + s > any pos) keep the whole stream sorted
-    tgt = jnp.where(live, pos, d + jnp.arange(meta.budget, dtype=jnp.int32))
-    dense = (
-        jnp.zeros((d,), vals.dtype)
-        .at[tgt]
-        .set(vals, mode="drop", unique_indices=True, indices_are_sorted=True)
-    )
-    return dense.reshape(shape)
+    return _sparse.scatter_ascending(vals, pos, nsel, meta.d).reshape(shape)
 
 
 def wire_bits(payload: BloomPayload, meta: BloomMeta) -> jax.Array:

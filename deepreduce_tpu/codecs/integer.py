@@ -15,11 +15,12 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from deepreduce_tpu import sparse
 from deepreduce_tpu.codecs import packing
 from deepreduce_tpu.sparse import SparseGrad
 
@@ -66,6 +67,31 @@ def decode(payload: IntegerPayload, meta: IntegerMeta, shape: Tuple[int, ...]) -
         nnz=payload.nnz,
         shape=shape,
     )
+
+
+def decode_dense(
+    payload: IntegerPayload,
+    meta: IntegerMeta,
+    shape: Tuple[int, ...],
+    *,
+    values: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Straight-to-dense decode — the TPU fast path the wrapper prefers.
+
+    Encode sorts ascending and deltas are zero past nnz, so the cumsum's
+    live prefix is strictly increasing and the dead tail parks at distinct
+    out-of-range targets: the scatter carries both the unique-indices and
+    sorted promises (sequential HBM walk instead of random access).
+    `values` overrides the payload's value stream ('both' mode passes the
+    value-codec output, already in ascending-index order)."""
+    k, d = meta.k, meta.d
+    deltas = packing.unpack(payload.deltas, k).astype(jnp.int32)
+    idx = jnp.clip(jnp.cumsum(deltas), 0, d - 1)
+    vals = payload.values if values is None else values
+    n_v = vals.shape[0]
+    vals = sparse.fit_length(vals, k)
+    nnz = jnp.minimum(payload.nnz, jnp.asarray(min(k, n_v), jnp.int32))
+    return sparse.scatter_ascending(vals, idx, nnz, d).reshape(shape)
 
 
 def wire_bits(payload: IntegerPayload, meta: IntegerMeta) -> jax.Array:

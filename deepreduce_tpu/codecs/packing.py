@@ -79,10 +79,15 @@ def pack(
     lo = v << off  # the (32-off) low bits land in word w0; overflow drops
     sh = jnp.where(off == 0, jnp.uint32(1), jnp.uint32(32) - off)
     hi = jnp.where(off == 0, jnp.uint32(0), v >> sh)  # spillover into w0+1
+    # two sorted scatter-adds (w0 is non-decreasing since p0 is ascending)
+    # instead of one shuffled concat — XLA:TPU walks the word array twice
+    # sequentially rather than random-access
     words = (
         jnp.zeros((nw,), jnp.uint32)
-        .at[jnp.concatenate([w0, w0 + 1])]
-        .add(jnp.concatenate([lo, hi]), mode="drop")
+        .at[w0]
+        .add(lo, mode="drop", indices_are_sorted=True)
+        .at[w0 + 1]
+        .add(hi, mode="drop", indices_are_sorted=True)
     )
     return PackedInts(words=words, count=jnp.asarray(n, jnp.int32), width=width)
 
@@ -94,9 +99,19 @@ def unpack(packed: PackedInts, n: int) -> jax.Array:
     p0 = jnp.arange(n, dtype=jnp.int32) * width
     w0 = jnp.clip(p0 >> 5, 0, last)
     off = (p0 & 31).astype(jnp.uint32)
-    lo = packed.words[w0] >> off
+    lo = jnp.take(packed.words, w0, indices_are_sorted=True, mode="clip") >> off
     sh = jnp.where(off == 0, jnp.uint32(1), jnp.uint32(32) - off)
-    hi = jnp.where(off == 0, jnp.uint32(0), packed.words[jnp.clip(w0 + 1, 0, last)] << sh)
+    hi = jnp.where(
+        off == 0,
+        jnp.uint32(0),
+        jnp.take(
+            packed.words,
+            jnp.clip(w0 + 1, 0, last),
+            indices_are_sorted=True,
+            mode="clip",
+        )
+        << sh,
+    )
     vals = (lo | hi) & _width_mask(width)
     live_vals = jnp.arange(n, dtype=jnp.int32) < packed.count
     return jnp.where(live_vals, vals, 0)

@@ -172,6 +172,11 @@ class IntegerCodec(Codec):
     def decode(self, payload, shape, *, step=0):
         return integer.decode(payload, self.meta, shape)
 
+    def decode_dense(self, payload, shape, *, step=0, values=None):
+        """TPU fast path: cumsum + one sorted unique scatter straight to
+        dense, skipping the SparseGrad materialization."""
+        return integer.decode_dense(payload, self.meta, shape, values=values)
+
     def index_wire_bits(self, payload):
         return integer.wire_bits(payload, self.meta)
 

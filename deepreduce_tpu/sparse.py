@@ -71,6 +71,34 @@ def live_mask(sp: SparseGrad) -> jax.Array:
     return jnp.arange(sp.k, dtype=jnp.int32) < sp.nnz
 
 
+def fit_length(vals: jax.Array, n: int) -> jax.Array:
+    """Zero-pad or truncate a value table to exactly `n` slots ('both' mode
+    can hand a codec a table shorter or longer than its budget)."""
+    if vals.shape[0] < n:
+        return jnp.zeros((n,), vals.dtype).at[: vals.shape[0]].set(vals)
+    return vals[:n]
+
+
+def scatter_ascending(
+    vals: jax.Array, pos: jax.Array, nsel: jax.Array, d: int
+) -> jax.Array:
+    """f32[d]: place `vals[s]` at `pos[s]` for live slots s < nsel.
+
+    The contract that makes this the TPU fast path: live `pos` is strictly
+    ascending and in [0, d). Dead slots park at distinct out-of-range targets
+    (d + s > every live position, still ascending), so the ONE scatter
+    carries both the unique-indices and sorted promises — XLA:TPU walks HBM
+    sequentially — and mode='drop' discards the parked tail."""
+    budget = vals.shape[0]
+    live = jnp.arange(budget, dtype=jnp.int32) < nsel
+    tgt = jnp.where(live, pos, d + jnp.arange(budget, dtype=jnp.int32))
+    return (
+        jnp.zeros((d,), vals.dtype)
+        .at[tgt]
+        .set(vals, mode="drop", unique_indices=True, indices_are_sorted=True)
+    )
+
+
 def num_slots(dense_size: int, compress_ratio: float) -> int:
     """k = max(1, N * ratio) (tensorflow/deepreduce.py:307-308)."""
     return max(1, int(dense_size * compress_ratio))

@@ -86,6 +86,37 @@ def test_integer_handles_partial_nnz():
     )
 
 
+@pytest.mark.parametrize("pad", [0, 10])
+def test_integer_decode_dense_matches_list_decode(pad):
+    """decode_dense (sorted unique scatter fast path) is an oracle match for
+    decode().to_dense(), including padded dead slots and a value override."""
+    _, _, sp = _sp_clustered(k=150, seed=4)
+    k = sp.k + pad
+    padded = sparse.SparseGrad(
+        values=jnp.zeros((k,), jnp.float32).at[: sp.k].set(sp.values),
+        indices=jnp.zeros((k,), jnp.int32).at[: sp.k].set(sp.indices),
+        nnz=sp.nnz,
+        shape=sp.shape,
+    )
+    meta = integer.IntegerMeta(k=k, d=sp.dense_size)
+    payload = integer.encode(padded, meta)
+    want = np.asarray(integer.decode(payload, meta, sp.shape).to_dense())
+    got = np.asarray(integer.decode_dense(payload, meta, sp.shape))
+    np.testing.assert_allclose(got, want)
+    # value override substitutes positionally (the 'both'-mode contract)
+    table = jnp.arange(1, k + 1, dtype=jnp.float32)
+    got2 = np.asarray(integer.decode_dense(payload, meta, sp.shape, values=table))
+    sp_dec = integer.decode(payload, meta, sp.shape)
+    n = int(sp_dec.nnz)
+    idx = np.asarray(sp_dec.indices)[:n]
+    np.testing.assert_allclose(got2[idx], np.asarray(table)[:n])
+    # every other coordinate stays zero (dead slots and table tail must not
+    # leak in-range)
+    rest = got2.copy()
+    rest[idx] = 0.0
+    np.testing.assert_array_equal(rest, np.zeros_like(rest))
+
+
 def test_huffman_round_trip_exact():
     g, sp = _sp(d=4096, ratio=0.05, seed=3)
     meta = huffman.HuffmanMeta(k=sp.k, d=sp.dense_size)

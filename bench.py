@@ -104,6 +104,15 @@ def exchange_time(m, bw):
     return m["payload_bytes"] / bw + m["t_encode_s"] + m["t_decode_s"]
 
 
+def _latest_midround_record() -> str:
+    """Newest committed BENCH_TPU_MIDROUND_*.json, or '' if none exist."""
+    import pathlib
+
+    here = pathlib.Path(__file__).parent
+    names = sorted(p.name for p in here.glob("BENCH_TPU_MIDROUND_*.json"))
+    return names[-1] if names else ""
+
+
 def _tpu_alive(timeout_s: float = 180.0) -> bool:
     """True if a trivial device round-trip completes within `timeout_s`,
     probed in a SUBPROCESS so a wedged axon tunnel (connection hang inside
@@ -427,6 +436,14 @@ def main() -> None:
         ),
         "platform": jax.devices()[0].platform,
         "degraded_to_cpu": degraded,  # true = probe failed, NOT a TPU result
+        # tunnel-outage insurance: when this run could not reach the TPU,
+        # point at the newest mid-round on-silicon record so the round
+        # still carries real-TPU codec numbers
+        **(
+            {"tpu_measurements_see": _latest_midround_record()}
+            if degraded and _latest_midround_record()
+            else {}
+        ),
         "configs": {
             n: {
                 "rel_volume": round(m["rel_volume"], 5),

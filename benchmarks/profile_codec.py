@@ -1,0 +1,134 @@
+"""Per-stage codec profiler — the micro-benchmark mode at stage granularity.
+
+The reference's `'micro-benchmark': True` times whole compress/decompress
+calls (pytorch/deepreduce.py:70-76); this tool additionally splits the
+flagship bloom pipeline into its stages (sparsify / insert / query+prefix /
+bloom-encode / value-codec / full encode / full decode) so a perf regression
+points at a stage, not a codec. Timing is amortized: `reps` async dispatches
+per synchronization, best of `iters` — the only reliable method through the
+axon tunnel, whose per-dispatch overhead (50-70ms) and `block_until_ready`
+semantics swamp single-call timings.
+
+    python benchmarks/profile_codec.py --d 4053428 --ratio 0.1 --fpr 0.02
+    python benchmarks/profile_codec.py --platform cpu   # structure check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+
+from bench import _sync  # noqa: E402 — the shared leaf-readback sync idiom
+
+
+def amortized(fn, *args, reps: int = 10, iters: int = 4) -> float:
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(reps)]
+        for o in outs:
+            _sync(o)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d", type=int, default=4_053_428)
+    ap.add_argument("--ratio", type=float, default=0.1)
+    ap.add_argument("--fpr", type=float, default=0.02)
+    ap.add_argument("--index", default="bloom")
+    ap.add_argument("--value", default="qsgd")
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    if args.platform:
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform(args.platform, device_count=1)
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.utils import enable_compile_cache
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    enable_compile_cache()
+    cfg = DeepReduceConfig.tpu_defaults(
+        compressor="topk",
+        compress_ratio=args.ratio,
+        deepreduce="both",
+        index=args.index,
+        value=args.value,
+        policy="p0",
+        fpr=args.fpr,
+    )
+    codec = TensorCodec((args.d,), cfg, name="profile")
+    rng = np.random.default_rng(0)
+    g = jnp.asarray((rng.normal(size=args.d) * rng.random(args.d) ** 2).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    stages = {}
+    geometry = {}
+
+    f_sp = jax.jit(lambda t: codec.sparsify(t, key=key))
+    sp = _sync(f_sp(g))
+    stages["sparsify"] = amortized(f_sp, g, reps=args.reps)
+
+    if args.index == "bloom":
+        from deepreduce_tpu.codecs import bloom
+
+        meta = codec.idx_codec.meta
+        geometry = {
+            "W_words": meta.m_bits // 32,
+            "num_hash": meta.num_hash,
+            "budget": meta.budget,
+            "blocked": meta.blocked,
+        }
+        f_ins = jax.jit(lambda i, n: bloom.insert(i, n, meta))
+        words = _sync(f_ins(sp.indices, sp.nnz))
+        stages["insert"] = amortized(f_ins, sp.indices, sp.nnz, reps=args.reps)
+
+        f_qp = jax.jit(
+            lambda w: bloom._prefix_positions(bloom.query_universe(w, meta), meta.budget)
+        )
+        _sync(f_qp(words))
+        stages["query+prefix"] = amortized(f_qp, words, reps=args.reps)
+
+        f_be = jax.jit(lambda s, t: bloom.encode(s, t, meta))
+        _sync(f_be(sp, g))
+        stages["bloom.encode"] = amortized(f_be, sp, g, reps=args.reps)
+
+    f_enc = jax.jit(lambda t, s: codec.encode(t, step=s, key=key))
+    payload = _sync(f_enc(g, 0))
+    stages["encode"] = amortized(f_enc, g, 1, reps=args.reps)
+
+    f_dec = jax.jit(lambda p, s: codec.decode(p, step=s))
+    _sync(f_dec(payload, 0))
+    stages["decode"] = amortized(f_dec, payload, 1, reps=args.reps)
+
+    out = {
+        "d": args.d,
+        "ratio": args.ratio,
+        "fpr": args.fpr,
+        "index": args.index,
+        "value": args.value,
+        "platform": jax.devices()[0].platform,
+        "meta": geometry,
+        "stages_ms": {k: round(v * 1e3, 3) for k, v in stages.items()},
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

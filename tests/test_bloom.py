@@ -342,3 +342,24 @@ def test_prefix_positions_edge_cases():
                 n = len(want)
                 assert int(count) == min(int(mask_np.sum()), budget)
                 np.testing.assert_array_equal(np.asarray(pos)[:n], want)
+
+
+def test_mod_insert_matches_membership_oracle_awkward_geometries():
+    """The sort-free mod insert (unique scatter + OR-reduce) must produce a
+    filter with NO false negatives at every awkward geometry: d smaller than
+    the word count, single-element universes, nnz=0, and non-divisible
+    rows."""
+    for d, k in ((1, 1), (7, 3), (33, 5), (1000, 100), (4097, 64)):
+        meta = bloom.BloomMeta.create(k, d, fpr=0.05, policy="p0", blocked="mod")
+        rng = np.random.default_rng(d)
+        idx = rng.choice(d, size=k, replace=False).astype(np.int32)
+        for nnz in (0, 1, k):
+            sp_idx = jnp.asarray(idx)
+            words = jax.jit(lambda i, n: bloom.insert(i, n, meta))(
+                sp_idx, jnp.int32(nnz)
+            )
+            mask = np.asarray(bloom.query_universe(words, meta))
+            live = idx[:nnz]
+            assert mask[live].all(), (d, k, nnz)
+            if nnz == 0:
+                assert int(np.asarray(words).sum()) == 0

@@ -42,3 +42,20 @@ $PY benchmarks/train.py --model resnet20 --num_steps $STEPS \
 
 echo "== Convergence parity (Table-1 methodology, dense vs compressed arm) =="
 $PY benchmarks/convergence.py --steps ${CONV_STEPS:-150}
+
+echo "== VGG16 (third PolySeg model family) with the flagship codec =="
+$PY benchmarks/train.py --model vgg16 --num_steps $STEPS --batch_size 16 \
+  --grace_config "{'compressor':'topk','compress_ratio':0.01,'memory':'residual','communicator':'allgather','deepreduce':'both','index':'bloom','value':'qsgd','fpr':0.02,'policy':'p0'}"
+
+echo "== Sparse reduce-scatter communicator (Ok-Topk shape; beyond the reference) =="
+$PY benchmarks/train.py --model resnet20 --num_steps $STEPS \
+  --grace_config "{'communicator':'sparse_rs','compressor':'topk','compress_ratio':0.01,'memory':'residual'}"
+
+echo "== MobileNet FedAvg (paper Table 5 shape) =="
+$PY benchmarks/mobilenet_table5.py --rounds ${FED_ROUNDS:-25}
+
+echo "== NCF natural-sparsity accounting (paper Table 6 shape) =="
+$PY benchmarks/ncf_table6.py
+
+echo "== Per-stage codec profile (flagship bloom pipeline) =="
+$PY benchmarks/profile_codec.py

@@ -49,6 +49,11 @@ def main():
     ap.add_argument("--value", default="qsgd")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--platform", default=None)
+    ap.add_argument(
+        "--threshold_insert",
+        action="store_true",
+        help="A/B: scatter-free insert_from_dense instead of the unique-scatter insert",
+    )
     args = ap.parse_args()
 
     if args.platform:
@@ -72,6 +77,7 @@ def main():
         value=args.value,
         policy="p0",
         fpr=args.fpr,
+        bloom_threshold_insert=args.threshold_insert,
     )
     codec = TensorCodec((args.d,), cfg, name="profile")
     rng = np.random.default_rng(0)
@@ -95,9 +101,15 @@ def main():
             "budget": meta.budget,
             "blocked": meta.blocked,
         }
-        f_ins = jax.jit(lambda i, n: bloom.insert(i, n, meta))
-        words = _sync(f_ins(sp.indices, sp.nnz))
-        stages["insert"] = amortized(f_ins, sp.indices, sp.nnz, reps=args.reps)
+        if args.threshold_insert:
+            thresh = jnp.min(jnp.abs(sp.values))
+            f_ins = jax.jit(lambda t, th: bloom.insert_from_dense(t, th, meta))
+            words = _sync(f_ins(g, thresh))
+            stages["insert"] = amortized(f_ins, g, thresh, reps=args.reps)
+        else:
+            f_ins = jax.jit(lambda i, n: bloom.insert(i, n, meta))
+            words = _sync(f_ins(sp.indices, sp.nnz))
+            stages["insert"] = amortized(f_ins, sp.indices, sp.nnz, reps=args.reps)
 
         f_qp = jax.jit(
             lambda w: bloom._prefix_positions(bloom.query_universe(w, meta), meta.budget)
@@ -105,7 +117,9 @@ def main():
         _sync(f_qp(words))
         stages["query+prefix"] = amortized(f_qp, words, reps=args.reps)
 
-        f_be = jax.jit(lambda s, t: bloom.encode(s, t, meta))
+        f_be = jax.jit(
+            lambda s, t: bloom.encode(s, t, meta, threshold_insert=args.threshold_insert)
+        )
         _sync(f_be(sp, g))
         stages["bloom.encode"] = amortized(f_be, sp, g, reps=args.reps)
 

@@ -328,6 +328,32 @@ def insert(indices: jax.Array, nnz: jax.Array, meta: BloomMeta) -> jax.Array:
     return _scatter_or(n_words, word, mask)
 
 
+def insert_from_dense(dense: jax.Array, thresh: jax.Array, meta: BloomMeta) -> jax.Array:
+    """Filter words from a magnitude threshold — the scatter-free mod-mode
+    insert: membership is ``|dense_j| >= thresh``, evaluated as a pure
+    elementwise pass over the same [rows, W] layout `query_universe`
+    broadcasts over, OR-reduced across rows. The inserted set is the
+    threshold superset of any top-k whose smallest kept magnitude is
+    `thresh` (ties join; bloom set semantics make that harmless, and the
+    FP-aware re-read keeps every decoded value true)."""
+    if meta.blocked != "mod":
+        raise ValueError("insert_from_dense requires the 'mod' blocked layout")
+    n_words = meta.m_bits // 32
+    rows = (meta.d + n_words - 1) // n_words
+    a = jnp.abs(dense.reshape(-1))
+    pad = rows * n_words - meta.d
+    if pad:
+        a = jnp.concatenate([a, jnp.zeros((pad,), a.dtype)])
+    j = (
+        jnp.arange(rows, dtype=jnp.uint32)[:, None] * jnp.uint32(n_words)
+        + jnp.arange(n_words, dtype=jnp.uint32)[None, :]
+    )
+    mask = lane_mask(j, meta.num_hash)
+    live = a.reshape(rows, n_words) >= thresh
+    contrib = jnp.where(live, mask, jnp.uint32(0))
+    return jax.lax.reduce(contrib, jnp.uint32(0), jax.lax.bitwise_or, (0,))
+
+
 def query_universe(words: jax.Array, meta: BloomMeta) -> jax.Array:
     """bool[d]: membership test for every index in the universe — the hot op
     (pytorch/deepreduce.py:466-477), chunked so the [chunk, h] position block
@@ -475,6 +501,7 @@ def encode(
     *,
     step: jax.Array = 0,
     seed: int = 0,
+    threshold_insert: bool = False,
 ) -> BloomPayload:
     """Insert + FP-aware value re-read (pytorch/deepreduce.py:505-533).
 
@@ -482,8 +509,30 @@ def encode(
     of scattering by it: `_prefix_positions` yields slot s's universe
     position, so values are ONE budget-scale gather from the dense tensor
     — no d-scale sort or scatter anywhere in encode. `select` remains for
-    the `random` policy."""
-    words = insert(sp.indices, sp.nnz, meta)
+    the `random` policy. `threshold_insert` swaps the unique-scatter insert
+    for the fully scatter-free `insert_from_dense` (mod mode with a dense
+    tensor only — anything else raises; the flag must never silently
+    compare a path against itself). A zero threshold would saturate the
+    filter (every |g| >= 0), so that case falls back to the scatter insert
+    under `lax.cond` — it happens when the sparsifier kept a zero value
+    (fewer true nonzeros than k)."""
+    if threshold_insert:
+        if meta.blocked != "mod" or dense is None:
+            raise ValueError(
+                "threshold_insert requires blocked='mod' and a dense tensor "
+                "(FP-aware encode); refusing to silently fall back"
+            )
+        live = jnp.arange(sp.k, dtype=jnp.int32) < sp.nnz
+        thresh = jnp.min(
+            jnp.where(live, jnp.abs(sp.values), jnp.inf).astype(jnp.float32)
+        )
+        words = jax.lax.cond(
+            thresh > 0,
+            lambda: insert_from_dense(dense, thresh.astype(dense.dtype), meta),
+            lambda: insert(sp.indices, sp.nnz, meta),
+        )
+    else:
+        words = insert(sp.indices, sp.nnz, meta)
     if dense is not None and meta.policy in ("leftmost", "p0"):
         flat = dense.reshape(-1)
         mask = query_universe(words, meta)

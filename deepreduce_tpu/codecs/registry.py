@@ -114,9 +114,36 @@ class BloomCodec(Codec):
             blocked=self.params.get("bloom_blocked", False),
         )
         self.seed = int(self.params.get("seed", 0))
+        self.threshold_insert = bool(self.params.get("bloom_threshold_insert", False))
+        if self.threshold_insert:
+            if self.meta.blocked != "mod":
+                raise ValueError(
+                    "bloom_threshold_insert requires bloom_blocked='mod' "
+                    f"(got {self.meta.blocked or 'classic'!r})"
+                )
+            # the threshold superset can exceed k (ties; approx-top-k misses
+            # above the kept minimum rejoin the filter) — widen the slot
+            # budget so ascending-prefix truncation doesn't bias against
+            # trailing parameters
+            import dataclasses as _dc
+            import math as _math
+
+            self.meta = _dc.replace(
+                self.meta,
+                budget=min(
+                    self.meta.d, self.meta.budget + int(_math.ceil(0.06 * k)) + 64
+                ),
+            )
 
     def encode(self, sp, dense=None, *, step=0, key=None):
-        return bloom.encode(sp, dense, self.meta, step=step, seed=self.seed)
+        return bloom.encode(
+            sp,
+            dense,
+            self.meta,
+            step=step,
+            seed=self.seed,
+            threshold_insert=self.threshold_insert,
+        )
 
     def decode(self, payload, shape, *, step=0):
         return bloom.decode(payload, self.meta, shape, step=step, seed=self.seed)

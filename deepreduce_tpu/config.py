@@ -44,6 +44,14 @@ class DeepReduceConfig:
     # W odd — the universe query becomes a pure broadcast, zero gathers
     # (measured-fastest TPU variant)
     bloom_blocked: Any = False  # False | True | 'hash' | 'mod'
+    # mod-blocked encode variant: build the filter from |dense| >= t (t =
+    # smallest kept magnitude) as a pure elementwise pass over the [rows, W]
+    # layout — zero scatters. The inserted set is the threshold superset of
+    # the sparsifier's selection (ties and any approx-top-k misses above t
+    # join the filter; bloom membership is a superset contract, and the
+    # FP-aware re-read keeps decoded values true). Off by default pending
+    # an on-silicon A/B against the unique-scatter insert.
+    bloom_threshold_insert: bool = False
     # native integer-codec family member for index='integer_native' — the
     # reference op's string attr `code` routed through
     # CODECFactory::getFromName (integer_compression.cc:62)
@@ -102,6 +110,7 @@ class DeepReduceConfig:
             "fpr": self.fpr,
             "policy": self.policy,
             "bloom_blocked": self.bloom_blocked,
+            "bloom_threshold_insert": self.bloom_threshold_insert,
             "code": self.code,
             "poly_degree": self.poly_degree,
             "quantum_num": self.quantum_num,

@@ -363,3 +363,56 @@ def test_mod_insert_matches_membership_oracle_awkward_geometries():
             assert mask[live].all(), (d, k, nnz)
             if nnz == 0:
                 assert int(np.asarray(words).sum()) == 0
+
+
+def test_threshold_insert_matches_scatter_insert():
+    """With an exact top-k selection over continuous values (ties have
+    measure zero), |dense| >= min-kept-magnitude IS the selected set, so
+    insert_from_dense must build the identical filter — and the full
+    encode/decode round trip must agree with the scatter-insert path."""
+    d = 50_000
+    rng = np.random.default_rng(21)
+    g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    sp = sparse.topk(g, 0.02)
+    meta = bloom.BloomMeta.create(sp.k, d, fpr=0.02, policy="p0", blocked="mod")
+    w_scatter = bloom.insert(sp.indices, sp.nnz, meta)
+    thresh = jnp.min(jnp.abs(sp.values))
+    w_thresh = bloom.insert_from_dense(g, thresh, meta)
+    np.testing.assert_array_equal(np.asarray(w_scatter), np.asarray(w_thresh))
+
+    p1 = bloom.encode(sp, g, meta)
+    p2 = bloom.encode(sp, g, meta, threshold_insert=True)
+    np.testing.assert_array_equal(np.asarray(p1.words), np.asarray(p2.words))
+    np.testing.assert_allclose(np.asarray(p1.values), np.asarray(p2.values))
+    assert int(p1.nsel) == int(p2.nsel)
+
+    out = np.asarray(bloom.decode_dense(p2, meta, (d,)))
+    sel = np.asarray(sp.indices)[: int(sp.nnz)]
+    np.testing.assert_allclose(out[sel], np.asarray(g)[sel])
+
+
+def test_threshold_insert_zero_threshold_falls_back():
+    """Fewer true nonzeros than k means the kept minimum magnitude is 0 —
+    a zero threshold would saturate the filter, so encode must fall back
+    to the scatter insert and produce the identical payload."""
+    d = 20_000
+    g_np = np.zeros(d, np.float32)
+    g_np[:50] = np.random.default_rng(5).normal(size=50)
+    g = jnp.asarray(g_np)
+    sp = sparse.topk(g, 0.01)  # k=200 > 50 nonzeros -> min kept value is 0
+    meta = bloom.BloomMeta.create(sp.k, d, fpr=0.02, policy="p0", blocked="mod")
+    p_scatter = bloom.encode(sp, g, meta)
+    p_thresh = jax.jit(
+        lambda s, t: bloom.encode(s, t, meta, threshold_insert=True)
+    )(sp, g)
+    np.testing.assert_array_equal(np.asarray(p_scatter.words), np.asarray(p_thresh.words))
+    np.testing.assert_allclose(np.asarray(p_scatter.values), np.asarray(p_thresh.values))
+
+
+def test_threshold_insert_config_rejects_non_mod():
+    from deepreduce_tpu.codecs.registry import get_codec
+
+    with pytest.raises(ValueError, match="bloom_blocked='mod'"):
+        get_codec("bloom", "index")(
+            100, 10_000, {"bloom_threshold_insert": True, "bloom_blocked": "hash"}
+        )

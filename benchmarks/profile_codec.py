@@ -102,7 +102,12 @@ def main():
             "blocked": meta.blocked,
         }
         if args.threshold_insert:
-            thresh = jnp.min(jnp.abs(sp.values))
+            # live-masked min with a zero guard, exactly as encode computes
+            # it — a kept zero value would otherwise saturate the filter and
+            # the A/B would time a degenerate all-ones table
+            live = jnp.arange(sp.k, dtype=jnp.int32) < sp.nnz
+            thresh = jnp.min(jnp.where(live, jnp.abs(sp.values), jnp.inf))
+            assert float(thresh) > 0, "degenerate input: kept zero magnitude"
             f_ins = jax.jit(lambda t, th: bloom.insert_from_dense(t, th, meta))
             words = _sync(f_ins(g, thresh))
             stages["insert"] = amortized(f_ins, g, thresh, reps=args.reps)

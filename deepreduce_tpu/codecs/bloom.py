@@ -217,6 +217,7 @@ class BloomMeta:
         fpr: Optional[float] = None,
         policy: str = "leftmost",
         blocked=False,
+        threshold_insert: bool = False,
     ) -> "BloomMeta":
         if policy == "conflict_sets":
             raise NotImplementedError(
@@ -228,6 +229,18 @@ class BloomMeta:
             m_bits, num_hash, fpr_eff = blocked_bloom_config(k, d, fpr, mode=blocked)
         else:
             m_bits, num_hash, fpr_eff = bloom_config(k, d, fpr)
+        budget = policy_budget(policy, k, d, fpr_eff)
+        if threshold_insert:
+            if blocked != "mod":
+                raise ValueError(
+                    "threshold_insert requires the 'mod' blocked layout "
+                    f"(got {blocked or 'classic'!r})"
+                )
+            # the threshold superset can exceed k (ties; approx-top-k misses
+            # above the kept minimum rejoin the filter) — widen the slot
+            # budget so ascending-prefix truncation doesn't bias against
+            # trailing parameters
+            budget = min(d, budget + int(math.ceil(0.06 * k)) + 64)
         return BloomMeta(
             d=d,
             k=k,
@@ -235,7 +248,7 @@ class BloomMeta:
             num_hash=num_hash,
             fpr=fpr_eff,
             policy=policy,
-            budget=policy_budget(policy, k, d, fpr_eff),
+            budget=budget,
             blocked=blocked,
         )
 
@@ -328,6 +341,20 @@ def insert(indices: jax.Array, nnz: jax.Array, meta: BloomMeta) -> jax.Array:
     return _scatter_or(n_words, word, mask)
 
 
+def _mod_grid(meta: BloomMeta) -> Tuple[int, jax.Array, jax.Array]:
+    """(rows, universe index grid j[rows, W], lane masks[rows, W]) — the
+    shared [ceil(d/W), W] layout both sides of the mod-blocked filter
+    broadcast over (encode's insert_from_dense and query_universe must
+    derive membership from the identical grid)."""
+    n_words = meta.m_bits // 32
+    rows = (meta.d + n_words - 1) // n_words
+    j = (
+        jnp.arange(rows, dtype=jnp.uint32)[:, None] * jnp.uint32(n_words)
+        + jnp.arange(n_words, dtype=jnp.uint32)[None, :]
+    )
+    return rows, j, lane_mask(j, meta.num_hash)
+
+
 def insert_from_dense(dense: jax.Array, thresh: jax.Array, meta: BloomMeta) -> jax.Array:
     """Filter words from a magnitude threshold — the scatter-free mod-mode
     insert: membership is ``|dense_j| >= thresh``, evaluated as a pure
@@ -339,16 +366,11 @@ def insert_from_dense(dense: jax.Array, thresh: jax.Array, meta: BloomMeta) -> j
     if meta.blocked != "mod":
         raise ValueError("insert_from_dense requires the 'mod' blocked layout")
     n_words = meta.m_bits // 32
-    rows = (meta.d + n_words - 1) // n_words
+    rows, _, mask = _mod_grid(meta)
     a = jnp.abs(dense.reshape(-1))
     pad = rows * n_words - meta.d
     if pad:
         a = jnp.concatenate([a, jnp.zeros((pad,), a.dtype)])
-    j = (
-        jnp.arange(rows, dtype=jnp.uint32)[:, None] * jnp.uint32(n_words)
-        + jnp.arange(n_words, dtype=jnp.uint32)[None, :]
-    )
-    mask = lane_mask(j, meta.num_hash)
     live = a.reshape(rows, n_words) >= thresh
     contrib = jnp.where(live, mask, jnp.uint32(0))
     return jax.lax.reduce(contrib, jnp.uint32(0), jax.lax.bitwise_or, (0,))
@@ -364,13 +386,7 @@ def query_universe(words: jax.Array, meta: BloomMeta) -> jax.Array:
         # natural order makes the word index cycle 0..W-1 — laying the
         # universe out as [ceil(d/W), W], each row tests against the whole
         # word array by broadcast. Pure elementwise + one reshape.
-        n_words = meta.m_bits // 32
-        rows = (d + n_words - 1) // n_words
-        j = (
-            jnp.arange(rows, dtype=jnp.uint32)[:, None] * jnp.uint32(n_words)
-            + jnp.arange(n_words, dtype=jnp.uint32)[None, :]
-        )
-        mask = lane_mask(j, meta.num_hash)
+        _, j, mask = _mod_grid(meta)
         hit = (words[None, :] & mask) == mask
         hit = jnp.logical_and(hit, j < jnp.uint32(d))
         return hit.reshape(-1)[:d]

@@ -106,34 +106,19 @@ class BloomCodec(Codec):
 
     def __init__(self, k, d, params=None):
         super().__init__(k, d, params)
-        self.meta = bloom.BloomMeta.create(
-            k,
-            d,
-            fpr=self.params.get("fpr"),
-            policy=self.params.get("policy", "leftmost"),
-            blocked=self.params.get("bloom_blocked", False),
-        )
-        self.seed = int(self.params.get("seed", 0))
         self.threshold_insert = bool(self.params.get("bloom_threshold_insert", False))
-        if self.threshold_insert:
-            if self.meta.blocked != "mod":
-                raise ValueError(
-                    "bloom_threshold_insert requires bloom_blocked='mod' "
-                    f"(got {self.meta.blocked or 'classic'!r})"
-                )
-            # the threshold superset can exceed k (ties; approx-top-k misses
-            # above the kept minimum rejoin the filter) — widen the slot
-            # budget so ascending-prefix truncation doesn't bias against
-            # trailing parameters
-            import dataclasses as _dc
-            import math as _math
-
-            self.meta = _dc.replace(
-                self.meta,
-                budget=min(
-                    self.meta.d, self.meta.budget + int(_math.ceil(0.06 * k)) + 64
-                ),
+        try:
+            self.meta = bloom.BloomMeta.create(
+                k,
+                d,
+                fpr=self.params.get("fpr"),
+                policy=self.params.get("policy", "leftmost"),
+                blocked=self.params.get("bloom_blocked", False),
+                threshold_insert=self.threshold_insert,
             )
+        except ValueError as e:
+            raise ValueError(f"bloom_threshold_insert: {e}") from e
+        self.seed = int(self.params.get("seed", 0))
 
     def encode(self, sp, dense=None, *, step=0, key=None):
         return bloom.encode(

@@ -23,15 +23,9 @@ class WordLSTM(nn.Module):
     @nn.compact
     def __call__(self, tokens):  # [batch, seq] int32
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(tokens)
-        cell = nn.OptimizedLSTMCell(self.hidden_dim, dtype=self.dtype)
-        batch = x.shape[0]
-        carry = cell.initialize_carry(jax.random.PRNGKey(0), (batch, self.embed_dim))
-
-        def step(carry, x_t):
-            carry, y = cell(carry, x_t)
-            return carry, y
-
-        _, ys = jax.lax.scan(step, carry, jnp.swapaxes(x, 0, 1))
-        h = jnp.swapaxes(ys, 0, 1)  # [batch, seq, hidden]
+        # nn.RNN is the sanctioned scan-over-cell: a bare lax.scan around a
+        # flax cell leaks the first trace's parameter tracers into later
+        # applies (UnexpectedTracerError on jit(apply) after an eager init)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, dtype=self.dtype))(x)
         h = nn.Dense(self.embed_dim, dtype=self.dtype)(h)
         return nn.Dense(self.vocab_size, dtype=jnp.float32)(h)

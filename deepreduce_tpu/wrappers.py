@@ -116,6 +116,18 @@ class TensorCodec:
                 "values in-band, so a value codec on top would transmit them "
                 "twice — use index='bloom' for 'both' mode"
             )
+        if (
+            cfg.bloom_threshold_insert
+            and cfg.index == "bloom"
+            and cfg.deepreduce in ("index", "both")
+            and cfg.compressor not in ("topk", "threshold")
+        ):
+            raise ValueError(
+                "bloom_threshold_insert rebuilds the selection as a magnitude "
+                f"threshold — incompatible with compressor={cfg.compressor!r} "
+                "(randomk/none selections are not magnitude sets); use topk "
+                "or threshold"
+            )
         params = cfg.codec_params()
         self.idx_codec = None
         self.val_codec = None

@@ -412,7 +412,7 @@ def test_threshold_insert_zero_threshold_falls_back():
 def test_threshold_insert_config_rejects_non_mod():
     from deepreduce_tpu.codecs.registry import get_codec
 
-    with pytest.raises(ValueError, match="bloom_blocked='mod'"):
+    with pytest.raises(ValueError, match="'mod' blocked layout"):
         get_codec("bloom", "index")(
             100, 10_000, {"bloom_threshold_insert": True, "bloom_blocked": "hash"}
         )

@@ -42,3 +42,16 @@ def test_vgg16_conv_layer_names_match_polyseg_whitelist():
         if re.search(r"(?i)conv", jax.tree_util.keystr(path)) and leaf.ndim == 4
     ]
     assert len(conv_kernels) == 13  # VGG16 configuration "D"
+
+
+def test_word_lstm_jit_apply_after_eager_init():
+    """Regression: the pre-nn.RNN WordLSTM leaked first-trace parameter
+    tracers from a bare lax.scan over the cell — eager init followed by a
+    jitted apply raised UnexpectedTracerError."""
+    from deepreduce_tpu.models import WordLSTM
+
+    m = WordLSTM(vocab_size=64, embed_dim=8, hidden_dim=16)
+    toks = jnp.zeros((2, 5), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks)["params"]
+    out = jax.jit(lambda p, t: m.apply({"params": p}, t))(params, toks)
+    assert out.shape == (2, 5, 64)

@@ -59,3 +59,6 @@ $PY benchmarks/ncf_table6.py
 
 echo "== Per-stage codec profile (flagship bloom pipeline) =="
 $PY benchmarks/profile_codec.py
+
+echo "== LSTM FedAvg 56 clients (paper Table 2 shape) =="
+$PY benchmarks/lstm_table2.py --rounds ${T2_ROUNDS:-25}

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement sweep — run when the device tunnel is up.
+# Appends one JSON line per measurement to $OUT (default tpu_sweep.jsonl)
+# so a tunnel drop mid-sweep loses only the in-flight measurement.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${OUT:-tpu_sweep.jsonl}
+PY=${PY:-python}
+LSTM_D=4053428
+R50_D=25557032
+
+probe() {
+  timeout 120 $PY -c "
+import jax, jax.numpy as jnp, numpy as np
+v = jax.jit(lambda t: t*2.0)(jnp.zeros((8,), jnp.float32))
+assert np.asarray(v[:1]) is not None
+print('tpu-ok')" 2>/dev/null | grep -q tpu-ok
+}
+
+if ! probe; then
+  echo "tunnel down — aborting sweep" >&2
+  exit 1
+fi
+
+run() {
+  echo "== $* ==" >&2
+  timeout 900 "$@" 2>/dev/null | tail -1 >> "$OUT" || echo "(failed: $*)" >&2
+}
+
+run $PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02
+run $PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --threshold_insert
+run $PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.001
+run $PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.001 --threshold_insert
+run $PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001
+run $PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --threshold_insert
+run $PY benchmarks/profile_codec.py --d $LSTM_D --index integer
+echo "== bench.py (full) ==" >&2
+timeout 3000 $PY bench.py 2>/dev/null | tail -1 >> "$OUT" || echo "(bench failed)" >&2
+echo "sweep done -> $OUT" >&2

@@ -120,6 +120,13 @@ SUITE = {
         "policy": "p0", "fpr": 0.02, "bloom_blocked": "mod",
         "min_compress_size": 500,
     },
+    # the repo bench's own headline config (bench.py drqsgd_delta): delta
+    # bit-packed indices + QSGD values — convergence-backed like the rest
+    "drqsgd_delta": {
+        "compressor": "topk", "compress_ratio": 0.1, "memory": "residual",
+        "deepreduce": "both", "index": "integer", "value": "qsgd",
+        "policy": "p0", "min_compress_size": 500,
+    },
 }
 
 

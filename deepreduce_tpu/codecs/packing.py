@@ -123,6 +123,39 @@ def wire_bits(packed: PackedInts) -> jax.Array:
     return 40 + packed.count * packed.width
 
 
+def pack3x21(values: jax.Array) -> jax.Array:
+    """3 x 21-bit values per int64 word — the reference's special-case
+    `pack_` (pytorch/deepreduce.py:165-180, the 'both'-mode mapping packer
+    for k < 2^21). Value i sits at bits [21*(i%3), 21*(i%3)+21) of 64-bit
+    word i//3; each word is emitted as its little-endian uint32 halves
+    (shape [ceil(n/3), 2]) so the layout survives jax_enable_x64=False,
+    where 64-bit lanes silently degrade to 32.
+
+    Wire-format parity shim, not a production path: the 'both' wrapper
+    packs mappings with the generic `pack` at ceil(log2 k) bits (denser —
+    0.657n vs 0.667n words at width 21, and valid for any k). This exists
+    so the reference's exact 3x21 layout (SURVEY.md §2.6) remains
+    producible and testable."""
+    n = values.shape[0]
+    nw = (n + 2) // 3
+    v = jnp.zeros((nw * 3,), jnp.uint32).at[:n].set(values & jnp.uint32((1 << 21) - 1))
+    v0, v1, v2 = v.reshape(nw, 3).T
+    lo = v0 | (v1 << jnp.uint32(21))  # bits 0..20 | 21..31 (low 11 of v1)
+    hi = (v1 >> jnp.uint32(11)) | (v2 << jnp.uint32(10))  # v1 bits 32..41, v2 42..62
+    return jnp.stack([lo, hi], axis=1)
+
+
+def unpack3x21(words: jax.Array, n: int) -> jax.Array:
+    """Inverse of `pack3x21` (the reference's `unpack_`,
+    pytorch/deepreduce.py:183-191)."""
+    m21 = jnp.uint32((1 << 21) - 1)
+    lo, hi = words[:, 0], words[:, 1]
+    v0 = lo & m21
+    v1 = ((lo >> jnp.uint32(21)) | (hi << jnp.uint32(11))) & m21
+    v2 = (hi >> jnp.uint32(10)) & m21
+    return jnp.stack([v0, v1, v2], axis=1).reshape(-1)[:n]
+
+
 def pack_bitmap(bits_u8: jax.Array) -> jax.Array:
     """uint8 0/1 array [m] -> uint32 words [ceil(m/32)], LSB-first (the CuPy
     ``packbits`` role, pytorch/deepreduce.py:446-450)."""

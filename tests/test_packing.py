@@ -53,3 +53,22 @@ def test_wire_bits_counts_meaningful_payload():
     vals = jnp.arange(100, dtype=jnp.uint32)
     packed = packing.pack(vals, jnp.asarray(7, jnp.int32))
     assert int(packing.wire_bits(packed)) == 40 + 100 * 7
+
+
+def test_pack3x21_round_trip():
+    """The reference's special-case 3x21-bit-per-int64 packers
+    (pytorch/deepreduce.py:165-191) — exact round trip at every length mod 3
+    and at the 21-bit boundary values."""
+    import numpy as np
+
+    from deepreduce_tpu.codecs.packing import pack3x21, unpack3x21
+
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 2, 3, 4, 7, 300):
+        vals = rng.integers(0, 1 << 21, size=n).astype(np.uint32)
+        if n:
+            vals[0] = (1 << 21) - 1
+        packed = pack3x21(jnp.asarray(vals))
+        assert packed.shape == ((n + 2) // 3, 2)
+        out = np.asarray(unpack3x21(packed, n))
+        np.testing.assert_array_equal(out, vals)

@@ -291,7 +291,7 @@ import json, time, numpy as np
 from deepreduce_tpu.utils import force_platform
 {pin}
 import jax, jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 from deepreduce_tpu.comm import GradientExchanger
 from deepreduce_tpu.config import DeepReduceConfig
@@ -324,7 +324,7 @@ def spmd(g):
                                key=jax.random.PRNGKey(0))
     return agg, wire
 fn = jax.jit(shard_map(spmd, mesh=mesh, in_specs=(P(),), out_specs=(P(), P()),
-                       check_rep=False))
+                       check_vma=False))
 agg, wire = fn(grads)
 sync(agg)
 t = max(timeit(fn, grads) - overhead, 1e-9)

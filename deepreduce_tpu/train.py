@@ -169,14 +169,14 @@ class Trainer:
             return dataclasses.replace(new_state, residuals=None), new_res, loss, wire
 
         res_spec = P(axis) if has_residuals else P()
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         fn = shard_map(
             spmd,
             mesh=self.mesh,
             in_specs=(P(), res_spec, P(axis), P()),
             out_specs=(P(), res_spec, P(), P()),
-            check_rep=False,
+            check_vma=False,
         )
         return jax.jit(fn)
 

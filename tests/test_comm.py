@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepreduce_tpu.comm import GradientExchanger
@@ -46,7 +46,7 @@ def _run_exchange(cfg, grads_w, mesh, step=0):
         mesh=mesh,
         in_specs=(P("data"), res_spec),
         out_specs=(P("data"), res_spec, P()),
-        check_rep=False,
+        check_vma=False,
     )
     agg, res, vol = jax.jit(fn)(jnp.asarray(grads_w), res0)
     return np.asarray(agg), res, float(vol), ex
@@ -180,7 +180,7 @@ def test_fused_multi_tensor_pytree_matches_oracle():
         spmd, mesh=mesh,
         in_specs=({n: P("data") for n in shapes},),
         out_specs=({n: P("data") for n in shapes}, P()),
-        check_rep=False,
+        check_vma=False,
     )
     agg, vol = jax.jit(fn)(jax.tree_util.tree_map(jnp.asarray, grads))
     for n, s in shapes.items():
@@ -226,7 +226,7 @@ def test_bf16_grads_keep_dtype_through_exchange(fused):
         spmd, mesh=mesh,
         in_specs=(P("data"), P("data")),
         out_specs=(P("data"), P("data")),
-        check_rep=False,
+        check_vma=False,
     )
     res0_w = jax.tree_util.tree_map(
         lambda r: jnp.broadcast_to(r[None], (4,) + r.shape), res0

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepreduce_tpu.config import DeepReduceConfig
@@ -39,7 +39,7 @@ def _run(cfg, grads):
             mesh=mesh,
             in_specs=(P(("dcn", "ici")),),
             out_specs=(P(("dcn", "ici")), P()),
-            check_rep=False,
+            check_vma=False,
         )
     )
     out, wire = fn(grads)
@@ -131,7 +131,7 @@ def test_folded_key_repaired_across_ici_replicas(key_style):
             spmd, mesh=mesh,
             in_specs=(P(("dcn", "ici")),),
             out_specs=P(("dcn", "ici")),
-            check_rep=False,
+            check_vma=False,
         )
     )
     out = np.asarray(fn(_grads())).reshape(N_SLICES * PER_SLICE, D)

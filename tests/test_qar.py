@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepreduce_tpu import qar
@@ -35,7 +35,7 @@ def _run_qar(grads, key, bucket=512):
     fn = jax.jit(
         shard_map(
             spmd, mesh=_mesh(), in_specs=(P("data"),), out_specs=P("data"),
-            check_rep=False,
+            check_vma=False,
         )
     )
     out = np.asarray(fn(jnp.asarray(padded))).reshape(W, n)[:, :D]

@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepreduce_tpu import sparse, sparse_rs
@@ -37,7 +37,7 @@ def _run(flat_w, ratio, headroom, out_headroom=1.0):
         shard_map(
             spmd, mesh=_mesh(), in_specs=(P("data"),),
             out_specs=(P("data"), P("data"), P()),
-            check_rep=False,
+            check_vma=False,
         )
     )
     return fn(flat_w)
@@ -151,7 +151,7 @@ def test_trainer_path_and_wire_accounting():
     fn = jax.jit(
         shard_map(
             spmd, mesh=_mesh(), in_specs=(P(), P()), out_specs=(P(), P(), P()),
-            check_rep=False,
+            check_vma=False,
         )
     )
     agg, new_state, stats = fn(grads, state)

@@ -114,23 +114,11 @@ def _latest_midround_record() -> str:
 
 
 def _tpu_alive(timeout_s: float = 180.0) -> bool:
-    """True if a trivial device round-trip completes within `timeout_s`,
-    probed in a SUBPROCESS so a wedged axon tunnel (connection hang inside
-    jax.devices()) can't poison this process's jax backend state."""
-    import subprocess
+    """Subprocess device probe (shared helper; a wedged axon tunnel hangs
+    inside jax.devices() and must never poison this process's backend)."""
+    from deepreduce_tpu.utils import device_responsive
 
-    code = (
-        "import jax, jax.numpy as jnp, numpy as np;"
-        "v = jax.jit(lambda t: t * 2.0)(jnp.zeros((8,), jnp.float32));"
-        "np.asarray(v[:1])"
-    )
-    try:
-        return subprocess.run(
-            [sys.executable, "-c", code], timeout=timeout_s,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        ).returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    return device_responsive(timeout_s=timeout_s)
 
 
 _PEAK_FLOPS_BF16 = {

@@ -52,6 +52,54 @@ def host_device_count_flags(flags: str, device_count: int) -> str:
     return " ".join(kept)
 
 
+def backends_initialized() -> bool:
+    """True once any JAX backend client exists (after which the platform can
+    no longer be switched in-process). Wraps the private xla_bridge probe in
+    one place so a jax upgrade breaks one helper, not every entry point."""
+    from jax._src import xla_bridge
+
+    try:
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:  # noqa: BLE001 — private API; fall back to the dict
+        return bool(getattr(xla_bridge, "_backends", {}))
+
+
+def device_responsive(
+    timeout_s: float = 120.0, attempts: int = 1, sleep_s: float = 60.0
+) -> bool:
+    """True if a trivial device round-trip completes within `timeout_s`,
+    probed in a SUBPROCESS: a wedged axon tunnel hangs inside the first
+    device_put with no way to recover in-process, so the probe must be
+    expendable. `attempts` > 1 retries with `sleep_s` pauses — the tunnel
+    wedges transiently and often recovers within minutes."""
+    import subprocess
+    import sys
+    import time
+
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np;"
+        "v = jax.jit(lambda t: t * 2.0)(jnp.zeros((8,), jnp.float32));"
+        "np.asarray(v[:1])"
+    )
+    for attempt in range(max(1, attempts)):
+        try:
+            if (
+                subprocess.run(
+                    [sys.executable, "-c", code],
+                    timeout=timeout_s,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                ).returncode
+                == 0
+            ):
+                return True
+        except Exception:  # noqa: BLE001 — includes TimeoutExpired
+            pass
+        if attempt + 1 < max(1, attempts):
+            time.sleep(sleep_s)
+    return False
+
+
 def force_platform(platform: str, device_count: int = 8) -> None:
     """Pin the JAX platform in-process. Env vars alone don't stick under the
     axon TPU tunnel, so anything that needs the virtual CPU mesh (tests,

@@ -127,6 +127,14 @@ SUITE = {
         "deepreduce": "both", "index": "integer", "value": "qsgd",
         "policy": "p0", "min_compress_size": 500,
     },
+    # the paper's Fit-DExp value family (§6.1): 4-coefficient double
+    # exponential over the kept magnitudes
+    "drdexp_bf_p0": {
+        "compressor": "topk", "compress_ratio": 0.1, "memory": "residual",
+        "deepreduce": "both", "index": "bloom", "value": "doubleexp",
+        "policy": "p0", "fpr": 0.02, "bloom_blocked": "mod",
+        "min_compress_size": 500,
+    },
 }
 
 

@@ -135,6 +135,16 @@ SUITE = {
         "policy": "p0", "fpr": 0.02, "bloom_blocked": "mod",
         "min_compress_size": 500,
     },
+    # beyond-reference collectives, convergence-backed like the codecs:
+    # int8 quantized reduce-scatter+allgather (EQuARX shape) ...
+    "qar_int8": {
+        "compressor": "none", "memory": "none", "communicator": "qar",
+    },
+    # ... and sparse reduce-scatter (Ok-Topk/SparCML shape)
+    "sparse_rs_topk": {
+        "compressor": "topk", "compress_ratio": 0.1, "memory": "residual",
+        "communicator": "sparse_rs",
+    },
 }
 
 

@@ -22,9 +22,13 @@ paper's 7.8x, so vs_baseline >= 1.0 means beating the reference's own
 number. ResNet-50-scale (25.6M) timings ride in `detail`.
 
 Timing note: axon's `block_until_ready` returns before execution completes,
-so synchronization reads one scalar of an output leaf back to host; the
-~50-70ms axon dispatch overhead is measured and subtracted via a no-op
-baseline.
+so synchronization reads one scalar of an output leaf back to host. All
+timings are AMORTIZED: `reps` async dispatches are enqueued, every output is
+synced once at the end, and wall time is divided by `reps` — the only
+reliable method through the device tunnel, whose 50-70ms per-dispatch
+overhead swamps (and whose early-returning sync can zero out) single-call
+timings. The residual per-dispatch enqueue cost is genuine pipeline cost and
+is reported, not subtracted, so no recorded time can clamp to 0.0.
 """
 
 from __future__ import annotations
@@ -56,16 +60,36 @@ def _sync(x):
     return x
 
 
-def _timeit(fn, *args, iters=5):
+def _timeit(fn, *args, iters=4, reps=10):
+    """Amortized timing: `reps` async dispatches, one sync pass over all
+    outputs, wall/reps; best of `iters`. Floored at 1us so a measurement can
+    never record as exactly 0.0 (which through the tunnel means "below
+    dispatch noise", not "free")."""
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
-        _sync(fn(*args))
-        best = min(best, time.perf_counter() - t0)
-    return best
+        outs = [fn(*args) for _ in range(reps)]
+        for o in outs:
+            _sync(o)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return max(best, 1e-6)
 
 
-def measure_config(d, ratio, cfg_kwargs, overhead, iters):
+def _last_json_line(text: str):
+    """Last stdout line that parses as a JSON object — stray trailing output
+    (e.g. a library printing at interpreter exit) must not replace the
+    record."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def measure_config(d, ratio, cfg_kwargs, iters):
     import jax
     import jax.numpy as jnp
 
@@ -87,9 +111,9 @@ def measure_config(d, ratio, cfg_kwargs, overhead, iters):
     payload = _sync(encode(g, 0))
     _progress(f"d={d}: compiling decode")
     _sync(decode(payload, 0))
-    _progress(f"d={d}: timing ({iters} iters)")
-    t_enc = max(_timeit(encode, g, 1, iters=iters) - overhead, 0.0)
-    t_dec = max(_timeit(decode, payload, 1, iters=iters) - overhead, 0.0)
+    _progress(f"d={d}: timing ({iters} iters, amortized)")
+    t_enc = _timeit(encode, g, 1, iters=iters)
+    t_dec = _timeit(decode, payload, 1, iters=iters)
     _progress(f"d={d}: done enc={t_enc:.4f}s dec={t_dec:.4f}s")
     stats = codec.wire_stats(payload)
     return {
@@ -142,7 +166,7 @@ def _chip_peak_flops() -> float:
     return 197e12
 
 
-def _model_throughput(overhead: float) -> dict:
+def _model_throughput() -> dict:
     """Full training-step throughput (fwd+bwd+codec+exchange), dense vs
     topk-1% bloom under the tpu_defaults preset, on the single available
     chip (mesh of 1; codec + exchange cost is real, the collective
@@ -191,13 +215,17 @@ def _model_throughput(overhead: float) -> dict:
             step = lambda s, i: trainer.step(s, (images, labels), jax.random.PRNGKey(i))
             state, _, _ = step(state, 0)
             _sync(state.params)
-            best = float("inf")
-            for i in range(3):
+            # amortized: chain `reps` steps asynchronously (each dispatch
+            # depends on the previous state but none blocks the host), sync
+            # once, divide — per-dispatch tunnel overhead amortizes away
+            reps, best = 5, float("inf")
+            for i in range(2):
                 t0 = time.perf_counter()
-                state, loss, _ = step(state, i + 1)
+                for r in range(reps):
+                    state, loss, _ = step(state, 1 + i * reps + r)
                 _sync(state.params)
-                best = min(best, time.perf_counter() - t0)
-            t_step = max(best - overhead, 1e-9)
+                best = min(best, (time.perf_counter() - t0) / reps)
+            t_step = max(best, 1e-9)
             entry = {
                 "images_per_sec": round(batch / t_step, 2),
                 "step_time_s": round(t_step, 4),
@@ -263,7 +291,6 @@ def _measured_exchange(degraded: bool) -> dict:
 
 
 def _exchange_subprocess(d: int, workers: int, pin_cpu: bool, timeout: int) -> dict:
-    import json as _json
     import os
     import subprocess
 
@@ -291,16 +318,15 @@ def sync(x):
         if getattr(leaf, "size", 0):
             np.asarray(leaf.reshape(-1)[0]); return x
     return x
-def timeit(fn, *args, iters=5):
+def timeit(fn, *args, iters=4, reps=6):
     best = float("inf")
     for _ in range(iters):
-        t0 = time.perf_counter(); sync(fn(*args))
-        best = min(best, time.perf_counter() - t0)
-    return best
-probe = jax.jit(lambda v: v[:8] * 2.0)
-z = jnp.zeros((1024,), jnp.float32)
-sync(probe(z))
-overhead = timeit(probe, z)
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(reps)]
+        for o in outs:
+            sync(o)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return max(best, 1e-6)
 cfg = DeepReduceConfig.tpu_defaults(
     compressor="topk", compress_ratio=0.10, deepreduce="both",
     index="bloom", value="qsgd", policy="p0", fpr=0.02, memory="none")
@@ -315,7 +341,7 @@ fn = jax.jit(shard_map(spmd, mesh=mesh, in_specs=(P(),), out_specs=(P(), P()),
                        check_vma=False))
 agg, wire = fn(grads)
 sync(agg)
-t = max(timeit(fn, grads) - overhead, 1e-9)
+t = timeit(fn, grads)
 payload = float(np.asarray(wire.total_bits)) / 8.0
 print(json.dumps({{
     "workers": nw, "t_step_s": round(t, 4),
@@ -339,8 +365,12 @@ print(json.dumps({{
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         if proc.returncode == 0:
-            return _json.loads(proc.stdout.strip().splitlines()[-1])
-        _progress(f"{label} failed rc={proc.returncode}: {proc.stderr[-300:]}")
+            rec = _last_json_line(proc.stdout)
+            if rec is not None:
+                return rec
+            _progress(f"{label} produced no JSON record")
+        else:
+            _progress(f"{label} failed rc={proc.returncode}: {proc.stderr[-300:]}")
     except Exception as e:  # noqa: BLE001 — bench must not die on a probe
         _progress(f"{label} skipped: {e}")
     return {}
@@ -381,8 +411,11 @@ def main() -> None:
                 text=True,
             )
             if proc.returncode == 0 and proc.stdout.strip():
-                print(proc.stdout.strip().splitlines()[-1])
-                return
+                rec = _last_json_line(proc.stdout)
+                if rec is not None:
+                    print(json.dumps(rec))
+                    return
+                _progress("TPU bench child emitted no JSON record; degrading to CPU")
             _progress(f"TPU bench child failed rc={proc.returncode}; degrading to CPU")
         except subprocess.TimeoutExpired:
             _progress("TPU bench child hung (tunnel wedged mid-run); degrading to CPU")
@@ -413,7 +446,8 @@ def main() -> None:
     d = LSTM_D if not quick else 500_000
     ratio = 0.10  # the paper's Top-r 10% LSTM setting (Table 2)
 
-    # dispatch overhead: a trivial jitted op, same sync path
+    # residual per-dispatch cost of a trivial jitted op under the same
+    # amortized protocol — reported for context, never subtracted
     probe = jax.jit(lambda v: v[:8] * 2.0)
     z = jnp.zeros((1024,), jnp.float32)
     _sync(probe(z))
@@ -434,7 +468,7 @@ def main() -> None:
         ),
     }
     measured = {
-        name: measure_config(d, ratio, kw, overhead, iters) for name, kw in configs.items()
+        name: measure_config(d, ratio, kw, iters) for name, kw in configs.items()
     }
     dense = {"payload_bytes": 4.0 * d, "rel_volume": 1.0, "t_encode_s": 0.0, "t_decode_s": 0.0}
 
@@ -489,7 +523,7 @@ def main() -> None:
                 fpr=0.001, memory="none",
             ),
         }.items():
-            r50 = measure_config(RESNET50_D, 0.01, rkw, overhead, 3)
+            r50 = measure_config(RESNET50_D, 0.01, rkw, 3)
             detail[rname] = {
                 "rel_volume": round(r50["rel_volume"], 5),
                 "t_encode_s": round(r50["t_encode_s"], 4),
@@ -518,7 +552,7 @@ def main() -> None:
         # metric): full fwd+bwd+compressed-exchange steps on the real chip.
         # The persistent compile cache makes repeat runs fast.
         try:
-            models = _model_throughput(overhead)
+            models = _model_throughput()
             detail["model_throughput"] = models
             r50 = models.get("resnet50", {}).get("topk1_bloom", {})
             if r50:

@@ -125,8 +125,19 @@ def main():
         f_be = jax.jit(
             lambda s, t: bloom.encode(s, t, meta, threshold_insert=args.threshold_insert)
         )
-        _sync(f_be(sp, g))
+        bpay = _sync(f_be(sp, g))
         stages["bloom.encode"] = amortized(f_be, sp, g, reps=args.reps)
+        # saturation guard (ADVICE r3): nsel == budget means the selection
+        # truncated — a threshold-insert A/B would compare different
+        # effective selections without this signal
+        geometry["nsel"] = int(bpay.nsel)
+        geometry["saturated"] = bool(bloom.saturated(bpay, meta))
+        if args.threshold_insert and geometry["saturated"]:
+            print(
+                "WARNING: threshold_insert saturated its widened budget "
+                f"(nsel == {meta.budget}); A/B timings are NOT comparable",
+                file=sys.stderr,
+            )
 
     f_enc = jax.jit(lambda t, s: codec.encode(t, step=s, key=key))
     payload = _sync(f_enc(g, 0))

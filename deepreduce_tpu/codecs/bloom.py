@@ -261,6 +261,16 @@ class BloomPayload:
     nsel: jax.Array  # i32[] — live selected count (p0 count prefix role)
 
 
+def saturated(payload: BloomPayload, meta: BloomMeta) -> jax.Array:
+    """True when the selection filled every slot (nsel == budget) — i.e.
+    `_prefix_positions` may have TRUNCATED trailing positives. Under
+    `threshold_insert` the widened budget (BloomMeta.create) is a heuristic;
+    a saturated payload means the threshold superset overflowed it and an
+    A/B against the scatter insert would compare different effective
+    selections. Harnesses must check this (ADVICE r3)."""
+    return jnp.asarray(payload.nsel, jnp.int32) >= jnp.int32(meta.budget)
+
+
 def _scatter_or(n_words: int, word_idx: jax.Array, masks: jax.Array) -> jax.Array:
     """uint32[n_words]: OR-combine `masks` into their target words.
 

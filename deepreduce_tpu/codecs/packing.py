@@ -126,10 +126,19 @@ def wire_bits(packed: PackedInts) -> jax.Array:
 def pack3x21(values: jax.Array) -> jax.Array:
     """3 x 21-bit values per int64 word — the reference's special-case
     `pack_` (pytorch/deepreduce.py:165-180, the 'both'-mode mapping packer
-    for k < 2^21). Value i sits at bits [21*(i%3), 21*(i%3)+21) of 64-bit
-    word i//3; each word is emitted as its little-endian uint32 halves
-    (shape [ceil(n/3), 2]) so the layout survives jax_enable_x64=False,
-    where 64-bit lanes silently degrade to 32.
+    for k < 2^21), bit-exact:
+
+      * values are padded by ``3 - n % 3`` zeros (always >= 1, the
+        reference's quirk), so ``nw = n//3 + 1`` data words;
+      * grouping is STRIDED thirds (``padded.view(3, -1)``): word j holds
+        values (j, j+nw, j+2nw);
+      * the FIRST component sits at the high bits:
+        ``word = v0 * 2^42 + v1 * 2^21 + v2``;
+      * a trailing element carrying ``n`` is appended (word nw).
+
+    Each int64 word is emitted as its little-endian uint32 halves (shape
+    [nw+1, 2], column 0 = low half) so the layout survives
+    jax_enable_x64=False, where 64-bit lanes silently degrade to 32.
 
     Wire-format parity shim, not a production path: the 'both' wrapper
     packs mappings with the generic `pack` at ceil(log2 k) bits (denser —
@@ -137,23 +146,34 @@ def pack3x21(values: jax.Array) -> jax.Array:
     so the reference's exact 3x21 layout (SURVEY.md §2.6) remains
     producible and testable."""
     n = values.shape[0]
-    nw = (n + 2) // 3
+    nw = n // 3 + 1  # padding = 3 - n % 3, always at least one zero
     v = jnp.zeros((nw * 3,), jnp.uint32).at[:n].set(values & jnp.uint32((1 << 21) - 1))
-    v0, v1, v2 = v.reshape(nw, 3).T
-    lo = v0 | (v1 << jnp.uint32(21))  # bits 0..20 | 21..31 (low 11 of v1)
-    hi = (v1 >> jnp.uint32(11)) | (v2 << jnp.uint32(10))  # v1 bits 32..41, v2 42..62
-    return jnp.stack([lo, hi], axis=1)
+    v0, v1, v2 = v.reshape(3, nw)  # strided thirds: word j <- (j, j+nw, j+2nw)
+    # word = v0<<42 | v1<<21 | v2, as little-endian uint32 halves
+    lo = v2 | (v1 << jnp.uint32(21))  # v2 bits 0..20 | low 11 bits of v1
+    hi = (v1 >> jnp.uint32(11)) | (v0 << jnp.uint32(10))  # v1 bits 32..41, v0 42..62
+    trailer = jnp.array([[n & 0xFFFFFFFF, n >> 32]], dtype=jnp.uint32)
+    return jnp.concatenate([jnp.stack([lo, hi], axis=1), trailer], axis=0)
 
 
 def unpack3x21(words: jax.Array, n: int) -> jax.Array:
     """Inverse of `pack3x21` (the reference's `unpack_`,
-    pytorch/deepreduce.py:183-191)."""
+    pytorch/deepreduce.py:183-191). `n` is the static value count; the
+    payload's own trailing count element (dynamic) must agree — callers
+    outside jit can check ``packed_count3x21``."""
     m21 = jnp.uint32((1 << 21) - 1)
-    lo, hi = words[:, 0], words[:, 1]
-    v0 = lo & m21
+    lo, hi = words[:-1, 0], words[:-1, 1]  # drop the trailing count element
+    v2 = lo & m21
     v1 = ((lo >> jnp.uint32(21)) | (hi << jnp.uint32(11))) & m21
-    v2 = (hi >> jnp.uint32(10)) & m21
-    return jnp.stack([v0, v1, v2], axis=1).reshape(-1)[:n]
+    v0 = (hi >> jnp.uint32(10)) & m21
+    # strided regrouping: cat([a1, a2, a3])[:n], reference unpack_ order
+    return jnp.concatenate([v0, v1, v2])[:n]
+
+
+def packed_count3x21(words: jax.Array) -> jax.Array:
+    """The trailing count element of a `pack3x21` payload (reference
+    ``encode[-1]``; low uint32 half — counts here are far below 2^32)."""
+    return words[-1, 0].astype(jnp.int32)
 
 
 def pack_bitmap(bits_u8: jax.Array) -> jax.Array:

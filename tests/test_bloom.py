@@ -1,6 +1,8 @@
 """Bloom index codec: no false negatives, FPR near config, policy
 determinism, FP-aware round trip (reference spec pytorch/deepreduce.py:431-555)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -407,6 +409,22 @@ def test_threshold_insert_zero_threshold_falls_back():
     )(sp, g)
     np.testing.assert_array_equal(np.asarray(p_scatter.words), np.asarray(p_thresh.words))
     np.testing.assert_allclose(np.asarray(p_scatter.values), np.asarray(p_thresh.values))
+
+
+def test_saturated_flags_budget_truncation():
+    """`bloom.saturated` (ADVICE r3): nsel == budget must read True — the
+    signal that `_prefix_positions` may have truncated trailing positives —
+    and False on a comfortably under-budget payload."""
+    d = 50_000
+    rng = np.random.default_rng(11)
+    g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    sp = sparse.topk(g, 0.02)
+    meta = bloom.BloomMeta.create(sp.k, d, fpr=0.02, policy="p0", blocked="mod")
+    pay = bloom.encode(sp, g, meta)
+    assert not bool(bloom.saturated(pay, meta))
+    # force truncation: same payload judged against a tiny claimed budget
+    tiny = dataclasses.replace(meta, budget=int(pay.nsel))
+    assert bool(bloom.saturated(pay, tiny))
 
 
 def test_threshold_insert_config_rejects_non_mod():

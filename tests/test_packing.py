@@ -55,13 +55,25 @@ def test_wire_bits_counts_meaningful_payload():
     assert int(packing.wire_bits(packed)) == 40 + 100 * 7
 
 
+def _reference_pack3x21_words(vals: np.ndarray) -> np.ndarray:
+    """The reference pack_'s int64 words, computed independently from its
+    documented layout (pytorch/deepreduce.py:165-180): pad by 3 - n%3 zeros
+    (always >= 1), view as strided thirds (3, nw), word = v0*2^42 + v1*2^21
+    + v2, append [n]."""
+    n = vals.size
+    nw = n // 3 + 1
+    padded = np.zeros(nw * 3, dtype=np.int64)
+    padded[:n] = vals
+    v0, v1, v2 = padded.reshape(3, nw)
+    words = v0 * (1 << 42) + v1 * (1 << 21) + v2
+    return np.concatenate([words, [n]]).astype(np.int64)
+
+
 def test_pack3x21_round_trip():
     """The reference's special-case 3x21-bit-per-int64 packers
     (pytorch/deepreduce.py:165-191) — exact round trip at every length mod 3
     and at the 21-bit boundary values."""
-    import numpy as np
-
-    from deepreduce_tpu.codecs.packing import pack3x21, unpack3x21
+    from deepreduce_tpu.codecs.packing import pack3x21, packed_count3x21, unpack3x21
 
     rng = np.random.default_rng(0)
     for n in (0, 1, 2, 3, 4, 7, 300):
@@ -69,6 +81,29 @@ def test_pack3x21_round_trip():
         if n:
             vals[0] = (1 << 21) - 1
         packed = pack3x21(jnp.asarray(vals))
-        assert packed.shape == ((n + 2) // 3, 2)
+        assert packed.shape == (n // 3 + 2, 2)  # nw = n//3+1 data + count
+        assert int(packed_count3x21(packed)) == n
         out = np.asarray(unpack3x21(packed, n))
         np.testing.assert_array_equal(out, vals)
+
+
+def test_pack3x21_matches_reference_word_layout():
+    """Bit-exact fixture vs the reference layout: reassemble our uint32
+    halves into int64 words and compare against the formula-computed
+    reference words (strided thirds, first component at high bits, trailing
+    count)."""
+    from deepreduce_tpu.codecs.packing import pack3x21
+
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 3, 6, 7, 100):
+        vals = rng.integers(0, 1 << 21, size=n).astype(np.uint32)
+        vals[-1] = (1 << 21) - 1
+        halves = np.asarray(pack3x21(jnp.asarray(vals))).astype(np.uint64)
+        ours = (halves[:, 0] | (halves[:, 1] << np.uint64(32))).astype(np.int64)
+        np.testing.assert_array_equal(ours, _reference_pack3x21_words(vals))
+    # hand-computed spot fixture: vals [1, 2, 3, 4] -> nw = 2, strided view
+    # rows (1,2),(3,4),(0,0): word0 = 1*2^42 + 3*2^21, word1 = 2*2^42 + 4*2^21
+    halves = np.asarray(pack3x21(jnp.asarray(np.array([1, 2, 3, 4], np.uint32))))
+    ours = (halves.astype(np.uint64)[:, 0] | (halves.astype(np.uint64)[:, 1] << np.uint64(32)))
+    expect = np.array([(1 << 42) + (3 << 21), (2 << 42) + (4 << 21), 4], np.uint64)
+    np.testing.assert_array_equal(ours, expect)

@@ -34,19 +34,29 @@ PAPER = {
 }
 
 
+BRANCH_PROBS = (0.7, 0.2, 0.1)
+
+
 def make_task(n, vocab, seq, seed, teacher_seed=3):
-    """Sequences from a fixed deterministic bigram teacher (next token is a
-    function of the current one) — learnable to ~100% top-1 in tens of
-    steps, identical for every arm and split; splits differ only in their
-    start tokens."""
+    """Sequences from a fixed STOCHASTIC bigram teacher: each token has 3
+    candidate successors drawn with probs 0.7/0.2/0.1, so the Bayes-optimal
+    top-1 accuracy is ~0.7 — the task cannot saturate at 1.0, making
+    compression-induced degradation observable (VERDICT r3 #3). Identical
+    teacher for every arm; splits differ in start tokens and transition
+    draws. Returns (x, y, bayes_y) with bayes_y the optimal prediction."""
     t_rng = np.random.default_rng(teacher_seed)
-    succ = t_rng.permutation(vocab).astype(np.int32)
+    succ = np.stack(
+        [t_rng.permutation(vocab) for _ in range(len(BRANCH_PROBS))], axis=1
+    ).astype(np.int32)  # [vocab, 3] candidate successors
     rng = np.random.default_rng(seed)
     toks = np.empty((n, seq + 1), np.int32)
     toks[:, 0] = rng.integers(0, vocab, size=n)
+    p = np.asarray(BRANCH_PROBS)
     for t in range(seq):
-        toks[:, t + 1] = succ[toks[:, t]]
-    return toks[:, :-1], toks[:, 1:]
+        choice = rng.choice(len(BRANCH_PROBS), size=n, p=p)
+        toks[:, t + 1] = succ[toks[:, t], choice]
+    x, y = toks[:, :-1], toks[:, 1:]
+    return x, y, succ[x, 0]
 
 
 def run_arm(cfg_params, rounds, seed, vocab=256, seq=16):
@@ -59,8 +69,8 @@ def run_arm(cfg_params, rounds, seed, vocab=256, seq=16):
     from deepreduce_tpu.models import WordLSTM
 
     model = WordLSTM(vocab_size=vocab, embed_dim=32, hidden_dim=64)
-    x, y = make_task(4096, vocab, seq, seed=1)
-    xe, ye = make_task(1024, vocab, seq, seed=2)
+    x, y, _ = make_task(4096, vocab, seq, seed=seed * 31 + 1)
+    xe, ye, bayes_ye = make_task(1024, vocab, seq, seed=seed * 31 + 2)
 
     def loss_fn(params, batch_xy):
         xb, yb = batch_xy
@@ -72,9 +82,13 @@ def run_arm(cfg_params, rounds, seed, vocab=256, seq=16):
         cfg = DeepReduceConfig.tpu_defaults(**cfg_params)
     else:
         cfg = DeepReduceConfig(compressor="none", memory="none")
-    # paper: 56 of 57 clients sampled per round
-    fed = FedConfig(num_clients=57, clients_per_round=56, local_steps=2)
-    fa = FedAvg(loss_fn, cfg, fed, optax.sgd(0.5, momentum=0.9))
+    # paper: 56 of 57 clients sampled per round. Client momentum restarts
+    # every round (client state is not federated), so with few local steps
+    # it barely amplifies the lr — the client lr is set high to compensate
+    # (central-training equivalent reaches the Bayes ceiling at
+    # lr_eff ~ 2-5 on this task)
+    fed = FedConfig(num_clients=57, clients_per_round=56, local_steps=4)
+    fa = FedAvg(loss_fn, cfg, fed, optax.sgd(2.0, momentum=0.9))
     state = fa.init(params)
     run_round = jax.jit(fa.run_round)
 
@@ -102,12 +116,14 @@ def run_arm(cfg_params, rounds, seed, vocab=256, seq=16):
         out_l = np.asarray(logits_fn(jnp.asarray(xe[lo : lo + 256])))
         correct += int((np.argmax(out_l, axis=-1) == ye[lo : lo + 256]).sum())
         total += out_l.shape[0] * out_l.shape[1]
-    return correct / total, vol
+    bayes = float((bayes_ye == ye).mean())
+    return correct / total, vol, bayes
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=25)
+    ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", default="cpu")
     args = ap.parse_args()
@@ -132,21 +148,44 @@ def main():
             fpr=0.02,
         ),
     }
+    seeds = list(range(max(1, args.seeds)))
     results = {}
-    dense_acc, _ = run_arm(None, args.rounds, seed=0)
-    results["dense"] = {"acc": round(dense_acc, 4)}
+    dense_accs, bayes_accs = {}, []
+    for s in seeds:
+        acc, _, bayes = run_arm(None, args.rounds, seed=s)
+        dense_accs[s] = acc
+        bayes_accs.append(bayes)
+        print(json.dumps({"dense": {"seed": s, "acc": round(acc, 4)}}), file=sys.stderr)
+    results["dense"] = {
+        "acc_mean": round(float(np.mean(list(dense_accs.values()))), 4),
+        "acc_std": round(float(np.std(list(dense_accs.values()))), 4),
+        "per_seed": [round(a, 4) for a in dense_accs.values()],
+    }
     for name, cp in configs.items():
-        acc, vol = run_arm(cp, args.rounds, seed=0)
+        accs, gaps, vol = [], [], None
+        for s in seeds:
+            acc, vol, _ = run_arm(cp, args.rounds, seed=s)
+            accs.append(acc)
+            gaps.append(dense_accs[s] - acc)
         results[name] = {
-            "acc": round(acc, 4),
-            "acc_gap_vs_dense": round(dense_acc - acc, 4),
+            "acc_mean": round(float(np.mean(accs)), 4),
+            "acc_std": round(float(np.std(accs)), 4),
+            "acc_gap_vs_dense_mean": round(float(np.mean(gaps)), 4),
+            "acc_gap_vs_dense_std": round(float(np.std(gaps)), 4),
+            "per_seed": [round(a, 4) for a in accs],
             "rel_volume": round(vol, 4),
             "paper_rel_volume": PAPER[name].get("rel_volume"),
         }
+        print(json.dumps({name: results[name]}), file=sys.stderr)
     vols = [results[n]["rel_volume"] for n in ("topr", "drbf_p0", "drqsgd_bf_p0")]
     out = {
-        "experiment": "WordLSTM FedAvg, 56/57 clients per round (paper Table 2 shape)",
+        "experiment": "WordLSTM FedAvg, 56/57 clients per round (paper Table 2 "
+                      "shape); stochastic bigram teacher — Bayes top-1 ceiling "
+                      "~0.7, so the task cannot saturate and degradation is "
+                      "observable",
         "rounds": args.rounds,
+        "n_seeds": len(seeds),
+        "bayes_ceiling": round(float(np.mean(bayes_accs)), 4),
         "paper_ordering": "topr 0.2033 > drbf_p0 0.1425 > drqsgd_bf_p0 0.0621",
         "ordering_holds": vols[0] > vols[1] > vols[2],
         "results": results,

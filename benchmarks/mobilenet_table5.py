@@ -29,8 +29,10 @@ PAPER_DENSE_ACC = 0.8817
 PAPER_COMPRESSED_ACC = 0.8740
 
 
-def make_task(n, classes, seed, size=16, proto_seed=1):
-    """Class-prototype images + noise: learnable, identical for both arms.
+def make_task(n, classes, seed, size=16, proto_seed=1, noise=2.5):
+    """Class-prototype images + heavy noise: learnable but NOT saturable —
+    the noise level is chosen so the smoke-scale model lands visibly below
+    1.0 (VERDICT r3 #3), making compression-induced degradation observable.
     Prototypes come from `proto_seed` so train and eval splits share the
     same classes and differ only in sampling noise."""
     protos = (
@@ -40,11 +42,11 @@ def make_task(n, classes, seed, size=16, proto_seed=1):
     )
     rng = np.random.default_rng(seed)
     y = rng.integers(0, classes, size=n).astype(np.int32)
-    x = protos[y] + 0.3 * rng.normal(size=(n, size, size, 3)).astype(np.float32)
+    x = protos[y] + noise * rng.normal(size=(n, size, size, 3)).astype(np.float32)
     return x, y
 
 
-def run_arm(cfg_params, rounds, seed, size=16, classes=10):
+def run_arm(cfg_params, rounds, seed, size=16, classes=10, noise=2.5):
     import jax
     import jax.numpy as jnp
     import optax
@@ -54,8 +56,11 @@ def run_arm(cfg_params, rounds, seed, size=16, classes=10):
     from deepreduce_tpu.models import MobileNetV1
 
     model = MobileNetV1(num_classes=classes, width_mult=0.25)
-    x, y = make_task(4096, classes, seed=1, size=size)
-    xe, ye = make_task(1024, classes, seed=2, size=size)
+    proto_seed = seed * 17 + 1
+    x, y = make_task(4096, classes, seed=seed * 17 + 2, size=size,
+                     proto_seed=proto_seed, noise=noise)
+    xe, ye = make_task(1024, classes, seed=seed * 17 + 3, size=size,
+                       proto_seed=proto_seed, noise=noise)
 
     variables = model.init(jax.random.PRNGKey(seed), jnp.asarray(x[:2]), train=True)
     params = variables["params"]
@@ -114,7 +119,9 @@ def run_arm(cfg_params, rounds, seed, size=16, classes=10):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rounds", type=int, default=25)
+    ap.add_argument("--rounds", type=int, default=40)
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--noise", type=float, default=2.5)
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", default="cpu")
     args = ap.parse_args()
@@ -134,19 +141,38 @@ def main():
         fpr=0.02,
         min_compress_size=500,
     )
-    dense_acc, _ = run_arm(None, args.rounds, seed=0)
-    comp_acc, vol = run_arm(drqsgd, args.rounds, seed=0)
+    seeds = list(range(max(1, args.seeds)))
+    dense_accs, comp_accs, gaps, vol = {}, [], [], None
+    for s in seeds:
+        dense_accs[s], _ = run_arm(None, args.rounds, seed=s, noise=args.noise)
+        print(json.dumps({"dense": {"seed": s, "acc": round(dense_accs[s], 4)}}),
+              file=sys.stderr)
+    for s in seeds:
+        acc, vol = run_arm(drqsgd, args.rounds, seed=s, noise=args.noise)
+        comp_accs.append(acc)
+        gaps.append(dense_accs[s] - acc)
+        print(json.dumps({"drqsgd": {"seed": s, "acc": round(acc, 4)}}),
+              file=sys.stderr)
     result = {
-        "experiment": "MobileNet FedAvg, 10 clients/round, DRQSGD-BF-P0 both ways (paper Table 5 shape)",
+        "experiment": "MobileNet FedAvg, 10 clients/round, DRQSGD-BF-P0 both "
+                      "ways (paper Table 5 shape); noise level keeps dense "
+                      "visibly below 1.0 so degradation is observable",
         "rounds": args.rounds,
+        "n_seeds": len(seeds),
+        "noise": args.noise,
         "paper": {
             "rel_volume": PAPER_REL_VOLUME,
             "dense_acc": PAPER_DENSE_ACC,
             "compressed_acc": PAPER_COMPRESSED_ACC,
         },
-        "dense_acc": round(dense_acc, 4),
-        "compressed_acc": round(comp_acc, 4),
-        "acc_gap": round(dense_acc - comp_acc, 4),
+        "dense_acc_mean": round(float(np.mean(list(dense_accs.values()))), 4),
+        "dense_acc_std": round(float(np.std(list(dense_accs.values()))), 4),
+        "compressed_acc_mean": round(float(np.mean(comp_accs)), 4),
+        "compressed_acc_std": round(float(np.std(comp_accs)), 4),
+        "acc_gap_mean": round(float(np.mean(gaps)), 4),
+        "acc_gap_std": round(float(np.std(gaps)), 4),
+        "per_seed_dense": [round(a, 4) for a in dense_accs.values()],
+        "per_seed_compressed": [round(a, 4) for a in comp_accs],
         "rel_volume": round(vol, 4),
         "config": drqsgd,
     }

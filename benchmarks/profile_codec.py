@@ -19,25 +19,17 @@ import argparse
 import json
 import pathlib
 import sys
-import time
-
 import numpy as np
 
 sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
 
 
-from bench import _sync  # noqa: E402 — the shared leaf-readback sync idiom
+from bench import _sync, _timeit  # noqa: E402 — shared sync + amortized timing
 
 
 def amortized(fn, *args, reps: int = 10, iters: int = 4) -> float:
-    best = float("inf")
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        outs = [fn(*args) for _ in range(reps)]
-        for o in outs:
-            _sync(o)
-        best = min(best, (time.perf_counter() - t0) / reps)
-    return best
+    """One timing protocol for the whole repo: bench._timeit."""
+    return _timeit(fn, *args, iters=iters, reps=reps)
 
 
 def main():
@@ -138,6 +130,31 @@ def main():
                 f"(nsel == {meta.budget}); A/B timings are NOT comparable",
                 file=sys.stderr,
             )
+
+        # composite sub-chains, to localize where the whole exceeds the sum
+        # of its parts (round-3 mystery: encode ~2x the stage sum):
+        # sparsify+bloom in ONE program — if this matches its parts, fusion
+        # across the sparsify/insert boundary is fine and the gap is later
+        f_sb = jax.jit(
+            lambda t: bloom.encode(
+                codec.sparsify(t, key=key), t, meta,
+                threshold_insert=args.threshold_insert,
+            )
+        )
+        _sync(f_sb(g))
+        stages["sparsify+bloom.encode"] = amortized(f_sb, g, reps=args.reps)
+
+    # index side of the full wrapper encode (sparsify + idx codec, no value
+    # codec / payload assembly): encode - encode_idx_only isolates the value
+    # codec AND the BothPayload assembly as they run inside the full graph
+    if codec.idx_codec is not None:
+        f_ei = jax.jit(
+            lambda t, s: codec.idx_codec.encode(
+                codec.sparsify(t, key=key), dense=t, step=s, key=key
+            )
+        )
+        _sync(f_ei(g, 0))
+        stages["encode_idx_only"] = amortized(f_ei, g, 1, reps=args.reps)
 
     f_enc = jax.jit(lambda t, s: codec.encode(t, step=s, key=key))
     payload = _sync(f_enc(g, 0))

@@ -13,6 +13,13 @@ P2 `conflict_sets` policy, which is native-only in the reference too
 Wire format is the C++ layer's own: ``[int32 m | int32 h | int32 count |
 count x int32 values | m/8 bytes bit-array]`` (bloom_filter_compression.cc:
 112-141 shape), padded to the static budget with an in-band byte length.
+
+Production route (round-4): the kernels execute as XLA custom calls
+(`native/xla_ops.bloom_compress/bloom_decompress`) INSIDE the jitted
+program — the counterpart of the reference loading its ops into the TF
+graph (tensorflow/deepreduce.py:328-330) — whenever the CPU FFI registry
+is available (`xla_ops.available()`); `jax.pure_callback` remains only as
+the fallback for platforms with no host custom-call execution.
 """
 
 from __future__ import annotations
@@ -68,9 +75,19 @@ def encode(
     step: jax.Array = 0,
 ) -> BloomNativePayload:
     from deepreduce_tpu import native
+    from deepreduce_tpu.native import xla_ops
 
     if dense is None:
         dense = sp.to_dense()
+
+    if xla_ops.available():
+        wire, nbytes, values, nsel = xla_ops.bloom_compress(
+            dense, sp.indices, sp.nnz, jnp.asarray(step, jnp.int32),
+            m_bits=meta.m_bits, num_hash=meta.num_hash,
+            policy_id=native.POLICY_IDS[meta.policy],
+            select_cap=meta.budget, wire_budget=meta.wire_budget,
+        )
+        return BloomNativePayload(wire=wire, nbytes=nbytes, values=values, nsel=nsel)
 
     def host(dense_np, idx_np, nnz_np, step_np):
         idx = np.asarray(idx_np, np.int32)[: int(nnz_np)]
@@ -108,6 +125,15 @@ def decode(
     step: jax.Array = 0,
 ) -> SparseGrad:
     from deepreduce_tpu import native
+    from deepreduce_tpu.native import xla_ops
+
+    if xla_ops.available():
+        vals, idxs, nsel = xla_ops.bloom_decompress(
+            payload.wire, payload.nbytes, jnp.asarray(step, jnp.int32),
+            d=meta.d, k=meta.k, policy_id=native.POLICY_IDS[meta.policy],
+            select_cap=meta.budget,
+        )
+        return SparseGrad(values=vals, indices=idxs, nnz=nsel, shape=shape)
 
     def host(wire_np, nbytes_np, step_np):
         wire = np.asarray(wire_np, np.int8)[: int(nbytes_np)]

@@ -36,6 +36,17 @@ class QSGDMeta:
         return (self.k + self.bucket_size - 1) // self.bucket_size
 
     @property
+    def level_bits(self) -> int:
+        """Meaningful bits per transmitted level: sign + magnitude at the
+        exact width of `quantum_num` (8 for the default q=127; 7 for the
+        paper's Table-6 NCF config, whose caption reads "7-bits
+        quantization" — q=63). The int8 container is an implementation
+        detail; wire accounting reports meaningful bits, as everywhere else
+        in this repo."""
+        q = self.quantum_num
+        return 1 + max(1, (q).bit_length())
+
+    @property
     def payload_len(self) -> int:
         return self.num_buckets * (self.bucket_size + 4)
 
@@ -81,7 +92,8 @@ def decode(payload: QSGDPayload, meta: QSGDMeta, shape: Tuple[int, ...]) -> Spar
 
 
 def wire_bits(payload: QSGDPayload, meta: QSGDMeta) -> jax.Array:
-    """8 bits per level + 32 bits of norm per bucket (reference layout)."""
+    """`level_bits` per level + 32 bits of norm per live bucket (reference
+    layout pytorch/deepreduce.py:876-880; 8 bits at the default q=127)."""
     nnz = payload.nnz.astype(jnp.float32)
     full_buckets = (nnz + meta.bucket_size - 1) // meta.bucket_size
-    return nnz * 8 + full_buckets * 32
+    return nnz * meta.level_bits + full_buckets * 32

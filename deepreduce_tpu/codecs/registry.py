@@ -507,9 +507,26 @@ class IntegerNativeCodec(Codec):
         import numpy as np
 
         from deepreduce_tpu import native
+        from deepreduce_tpu.native import xla_ops
 
         k, budget = self.k, self.budget_words
         code = self.code
+
+        if xla_ops.available():
+            # production route: sort in-graph (dead slots keyed past every
+            # live index so they fall to the tail), then the name-keyed
+            # C++ encoder as ONE custom call inside the jitted program
+            live = jnp.arange(k, dtype=jnp.int32) < sp.nnz
+            keyed = jnp.where(live, sp.indices, jnp.int32(self.d))
+            order = jnp.argsort(keyed, stable=True)
+            sorted_idx = jnp.take(keyed, order)
+            sorted_vals = jnp.where(live, jnp.take(sp.values, order), 0.0)
+            wire, nwords = xla_ops.int_encode(
+                sorted_idx.astype(jnp.uint32), sp.nnz, code, budget
+            )
+            return IntegerNativePayload(
+                values=sorted_vals, wire=wire, nwords=nwords, nnz=sp.nnz
+            )
 
         def host(idx_np, val_np, nnz_np):
             enc, _ = native.int_codec_from_name(code)
@@ -539,9 +556,22 @@ class IntegerNativeCodec(Codec):
         import numpy as np  # noqa: F401 (host fn below)
 
         from deepreduce_tpu import native  # noqa: F401
+        from deepreduce_tpu.native import xla_ops
 
         k = self.k
         code = self.code
+
+        if xla_ops.available():
+            idx = xla_ops.int_decode(payload.wire, payload.nwords, code, k)
+            live = jnp.arange(k, dtype=jnp.int32) < payload.nnz
+            from deepreduce_tpu.sparse import SparseGrad
+
+            return SparseGrad(
+                values=jnp.where(live, payload.values, 0.0),
+                indices=jnp.where(live, idx.astype(jnp.int32), 0),
+                nnz=payload.nnz,
+                shape=shape,
+            )
 
         def host(wire_np, nwords_np, nnz_np):
             _, dec = native.int_codec_from_name(code)

@@ -30,6 +30,12 @@ int32_t drn_bloom_query_universe(const uint8_t*, int32_t, int32_t, int32_t, uint
 int32_t drn_fbp_decode(const uint32_t*, int32_t, uint32_t*, int32_t);
 int32_t drn_varint_decode(const uint8_t*, int32_t, uint32_t*, int32_t);
 int32_t drn_int_encode_named(const char*, const uint32_t*, int32_t, uint32_t*, int32_t);
+int32_t drn_int_decode_named(const char*, const uint32_t*, int32_t, uint32_t*, int32_t);
+int32_t drn_bloom_compress(const float*, const int32_t*, int32_t, int32_t,
+                           int32_t, int32_t, int32_t, int64_t, int32_t,
+                           int8_t*, int32_t);
+int32_t drn_bloom_decompress(const int8_t*, int32_t, int32_t, int32_t,
+                             int32_t, int64_t, float*, int32_t*, int32_t);
 }
 
 static ffi::Error BloomQueryImpl(ffi::Buffer<ffi::U8> bitmap, int64_t num_hash,
@@ -118,4 +124,124 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Arg<ffi::Buffer<ffi::S32>>()
         .Attr<std::string_view>("code")
         .Ret<ffi::Buffer<ffi::U32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());
+
+// (words u32[cap], nwords i32[1], code) -> values u32[cap_out] — the
+// name-keyed decode twin of DrnIntEncode; unused output slots zeroed.
+static ffi::Error IntDecodeImpl(ffi::Buffer<ffi::U32> words,
+                                ffi::Buffer<ffi::S32> nwords,
+                                std::string_view code,
+                                ffi::ResultBuffer<ffi::U32> out) {
+  int32_t cap = (int32_t)out->element_count();
+  std::memset(out->typed_data(), 0, (size_t)cap * 4);
+  int32_t nw = nwords.typed_data()[0];
+  if (nw < 0 || nw > (int32_t)words.element_count())
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "bad live word count");
+  std::string code_s(code);
+  int32_t n = drn_int_decode_named(code_s.c_str(), words.typed_data(), nw,
+                                   out->typed_data(), cap);
+  if (n < 0)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "int decode failed");
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    DrnIntDecode, IntDecodeImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::U32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Attr<std::string_view>("code")
+        .Ret<ffi::Buffer<ffi::U32>>());
+
+// Full bloom wire codec (the reference's paired BloomCompressorOp /
+// BloomDecompressorOp, bloom_filter_compression.cc:72-153) as custom
+// calls: insert + query + policy select + wire assembly in one handler.
+// `step` rides as a data buffer (it is a traced value under jit).
+// Extra result buffers carry the selected values and live count straight
+// out of the wire the compressor just assembled (nsel at byte offset 8,
+// values from offset 12) — encode is ONE custom call, no decompress round
+// trip to re-derive what compress already computed.
+static ffi::Error BloomCompressImpl(ffi::Buffer<ffi::F32> dense,
+                                    ffi::Buffer<ffi::S32> indices,
+                                    ffi::Buffer<ffi::S32> nnz,
+                                    ffi::Buffer<ffi::S32> step,
+                                    int64_t m_bits, int64_t num_hash,
+                                    int64_t policy, int64_t select_cap,
+                                    ffi::ResultBuffer<ffi::S8> wire,
+                                    ffi::ResultBuffer<ffi::S32> nbytes,
+                                    ffi::ResultBuffer<ffi::F32> values,
+                                    ffi::ResultBuffer<ffi::S32> nsel) {
+  int32_t cap = (int32_t)wire->element_count();
+  std::memset(wire->typed_data(), 0, cap);
+  int32_t vcap = (int32_t)values->element_count();
+  std::memset(values->typed_data(), 0, (size_t)vcap * 4);
+  int32_t k = nnz.typed_data()[0];
+  if (k < 0 || k > (int32_t)indices.element_count())
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "bad live count");
+  int32_t n = drn_bloom_compress(
+      dense.typed_data(), indices.typed_data(), k,
+      (int32_t)dense.element_count(), (int32_t)m_bits, (int32_t)num_hash,
+      (int32_t)policy, (int64_t)step.typed_data()[0], (int32_t)select_cap,
+      wire->typed_data(), cap);
+  if (n < 0)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "bloom compress failed");
+  nbytes->typed_data()[0] = n;
+  int32_t ns;
+  std::memcpy(&ns, wire->typed_data() + 8, 4);
+  if (ns > vcap) ns = vcap;
+  std::memcpy(values->typed_data(), wire->typed_data() + 12, (size_t)ns * 4);
+  nsel->typed_data()[0] = ns;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    DrnBloomCompress, BloomCompressImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Attr<int64_t>("m_bits")
+        .Attr<int64_t>("num_hash")
+        .Attr<int64_t>("policy")
+        .Attr<int64_t>("select_cap")
+        .Ret<ffi::Buffer<ffi::S8>>()
+        .Ret<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());
+
+static ffi::Error BloomDecompressImpl(ffi::Buffer<ffi::S8> wire,
+                                      ffi::Buffer<ffi::S32> nbytes,
+                                      ffi::Buffer<ffi::S32> step,
+                                      int64_t d, int64_t k, int64_t policy,
+                                      ffi::ResultBuffer<ffi::F32> values,
+                                      ffi::ResultBuffer<ffi::S32> indices,
+                                      ffi::ResultBuffer<ffi::S32> nsel) {
+  int32_t cap = (int32_t)values->element_count();
+  std::memset(values->typed_data(), 0, (size_t)cap * 4);
+  std::memset(indices->typed_data(), 0, (size_t)indices->element_count() * 4);
+  int32_t len = nbytes.typed_data()[0];
+  if (len < 0 || len > (int32_t)wire.element_count())
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "bad wire length");
+  int32_t n = drn_bloom_decompress(
+      wire.typed_data(), len, (int32_t)d, (int32_t)k, (int32_t)policy,
+      (int64_t)step.typed_data()[0], values->typed_data(),
+      indices->typed_data(), cap);
+  if (n < 0)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "bloom decompress failed");
+  nsel->typed_data()[0] = n;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    DrnBloomDecompress, BloomDecompressImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::S8>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Attr<int64_t>("d")
+        .Attr<int64_t>("k")
+        .Attr<int64_t>("policy")
+        .Ret<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::S32>>()
         .Ret<ffi::Buffer<ffi::S32>>());

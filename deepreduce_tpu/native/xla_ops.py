@@ -45,9 +45,26 @@ def register() -> None:
         ("drn_fbp_decode", "DrnFbpDecode"),
         ("drn_varint_decode", "DrnVarintDecode"),
         ("drn_int_encode", "DrnIntEncode"),
+        ("drn_int_decode", "DrnIntDecode"),
+        ("drn_bloom_compress", "DrnBloomCompress"),
+        ("drn_bloom_decompress", "DrnBloomDecompress"),
     ]:
         jax.ffi.register_ffi_target(name, jax.ffi.pycapsule(getattr(lib, sym)), platform="cpu")
     _registered = True
+
+
+def available() -> bool:
+    """True when the FFI route can serve as the production native path:
+    CPU platform (the axon TPU PJRT executes no host custom-calls) and the
+    library builds/registers. Codecs fall back to `pure_callback` when
+    False."""
+    try:
+        if jax.default_backend() != "cpu":
+            return False
+        register()
+        return True
+    except Exception:  # noqa: BLE001 — any build/registration failure
+        return False
 
 
 def bloom_query(bitmap_bytes: jax.Array, num_hash: int, d: int) -> jax.Array:
@@ -96,3 +113,83 @@ def int_encode(vals: jax.Array, count: jax.Array, code: str, cap_words: int):
         ),
     )(vals.astype(jnp.uint32), count.reshape(1).astype(jnp.int32), code=code)
     return words, nwords[0]
+
+
+def int_decode(words: jax.Array, nwords: jax.Array, code: str, n: int) -> jax.Array:
+    """(u32 wire words, i32[] live word count) -> u32[n] decoded values —
+    the name-keyed decode twin of `int_encode`."""
+    register()
+    return jax.ffi.ffi_call("drn_int_decode", jax.ShapeDtypeStruct((n,), jnp.uint32))(
+        words, nwords.reshape(1).astype(jnp.int32), code=code
+    )
+
+
+def bloom_compress(
+    dense: jax.Array,
+    indices: jax.Array,
+    nnz: jax.Array,
+    step: jax.Array,
+    *,
+    m_bits: int,
+    num_hash: int,
+    policy_id: int,
+    select_cap: int,
+    wire_budget: int,
+):
+    """Full C++ bloom wire compress (insert + query + policy select +
+    assemble) as ONE custom call — the BloomCompressorOp role. Returns
+    (wire i8[wire_budget] zero-padded, nbytes i32[], values f32[select_cap],
+    nsel i32[]) — the selected values/count are copied out of the assembled
+    wire by the handler, so encode needs no decompress round trip."""
+    register()
+    wire, nbytes, values, nsel = jax.ffi.ffi_call(
+        "drn_bloom_compress",
+        (
+            jax.ShapeDtypeStruct((wire_budget,), jnp.int8),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((select_cap,), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ),
+    )(
+        dense.reshape(-1).astype(jnp.float32),
+        indices.astype(jnp.int32),
+        nnz.reshape(1).astype(jnp.int32),
+        step.reshape(1).astype(jnp.int32),
+        m_bits=np.int64(m_bits),
+        num_hash=np.int64(num_hash),
+        policy=np.int64(policy_id),
+        select_cap=np.int64(select_cap),
+    )
+    return wire, nbytes[0], values, nsel[0]
+
+
+def bloom_decompress(
+    wire: jax.Array,
+    nbytes: jax.Array,
+    step: jax.Array,
+    *,
+    d: int,
+    k: int,
+    policy_id: int,
+    select_cap: int,
+):
+    """C++ bloom wire decompress as ONE custom call — the
+    BloomDecompressorOp role. Returns (values f32[select_cap],
+    indices i32[select_cap], nsel i32[])."""
+    register()
+    values, indices, nsel = jax.ffi.ffi_call(
+        "drn_bloom_decompress",
+        (
+            jax.ShapeDtypeStruct((select_cap,), jnp.float32),
+            jax.ShapeDtypeStruct((select_cap,), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ),
+    )(
+        wire.astype(jnp.int8),
+        nbytes.reshape(1).astype(jnp.int32),
+        step.reshape(1).astype(jnp.int32),
+        d=np.int64(d),
+        k=np.int64(k),
+        policy=np.int64(policy_id),
+    )
+    return values, indices, nsel[0]

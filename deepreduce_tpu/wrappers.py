@@ -299,12 +299,23 @@ class TensorCodec:
         if not self.compressed:
             nnz = payload.nnz.astype(jnp.float32)
             # a dense transmission (no sparsifier, or pattern-excluded layer)
-            # carries no index stream
+            # carries no index stream; and a sparse (idx, val) transmission
+            # that would EXCEED the raw tensor falls back to transmitting
+            # dense — the reference's bypass ships the tensor as-is
+            # (pytorch/deepreduce.py:68), so no leaf may account > 1.0
             dense_tx = self.cfg.compressor == "none" or self.pattern_excluded
-            idx_bits = jnp.zeros(()) if dense_tx else nnz * 32
-            val_bits = nnz * 32
+            sparse_beats_dense = nnz * 64 < dense_bits
+            use_sparse = jnp.logical_and(jnp.logical_not(dense_tx), sparse_beats_dense)
+            idx_bits = jnp.where(use_sparse, nnz * 32, 0.0)
+            val_bits = jnp.where(use_sparse, nnz * 32, dense_bits)
         elif self.cfg.deepreduce == "value":
-            idx_bits = self.val_codec.index_wire_bits(payload)
+            # positional dense transmission (no sparsifier): values arrive in
+            # slot order covering the whole tensor — the plain-QSGD wire has
+            # no index stream
+            if self.cfg.compressor == "none":
+                idx_bits = jnp.zeros(())
+            else:
+                idx_bits = self.val_codec.index_wire_bits(payload)
             val_bits = self.val_codec.value_wire_bits(payload)
         elif self.cfg.deepreduce == "index":
             idx_bits = self.idx_codec.index_wire_bits(payload)

@@ -40,6 +40,10 @@ def main():
     ap.add_argument("--index", default="bloom")
     ap.add_argument("--value", default="qsgd")
     ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument(
+        "--compressor", default="topk",
+        help="sparsifier for the pipeline arms (topk | topk_sampled | ...)",
+    )
     ap.add_argument("--platform", default=None)
     ap.add_argument(
         "--threshold_insert",
@@ -62,7 +66,7 @@ def main():
 
     enable_compile_cache()
     cfg = DeepReduceConfig.tpu_defaults(
-        compressor="topk",
+        compressor=args.compressor,
         compress_ratio=args.ratio,
         deepreduce="both",
         index=args.index,
@@ -82,6 +86,19 @@ def main():
     f_sp = jax.jit(lambda t: codec.sparsify(t, key=key))
     sp = _sync(f_sp(g))
     stages["sparsify"] = amortized(f_sp, g, reps=args.reps)
+
+    # standalone sparsifier A/B at this d/ratio: exact O(d log k) top_k vs
+    # TPU approx_max_k vs the sortless sampled-threshold selection
+    from deepreduce_tpu import sparse as sparse_mod
+
+    for label, fn in [
+        ("sparsify_exact", lambda t: sparse_mod.topk(t, args.ratio)),
+        ("sparsify_approx", lambda t: sparse_mod.topk(t, args.ratio, approx=True)),
+        ("sparsify_sampled", lambda t: sparse_mod.topk_sampled(t, args.ratio)),
+    ]:
+        f = jax.jit(fn)
+        _sync(f(g))
+        stages[label] = amortized(f, g, reps=args.reps)
 
     if args.index == "bloom":
         from deepreduce_tpu.codecs import bloom

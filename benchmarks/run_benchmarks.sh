@@ -8,6 +8,28 @@ cd "$(dirname "$0")/.."
 STEPS=${STEPS:-20}
 PY=${PY:-python}
 
+# Artifact-freshness gate (r4 review: committed tables must come from the
+# committed harnesses). Any table artifact older than the harness (or the
+# wrapper/accounting layer) that produces it is flagged up front.
+check_fresh() {  # check_fresh ARTIFACT SRC...
+  local art="$1"; shift
+  [ -f "$art" ] || return 0
+  for src in "$@" deepreduce_tpu/wrappers.py deepreduce_tpu/metrics.py; do
+    if [ "$src" -nt "$art" ]; then
+      echo "STALE ARTIFACT: $art is older than $src — regenerate it" >&2
+      STALE=1
+    fi
+  done
+}
+STALE=0
+check_fresh CONVERGENCE.json benchmarks/convergence.py
+check_fresh LSTM_TABLE2.json benchmarks/lstm_table2.py
+check_fresh MOBILENET_TABLE5.json benchmarks/mobilenet_table5.py
+check_fresh NCF_TABLE6.json benchmarks/ncf_table6.py
+if [ "${STRICT_FRESH:-0}" = "1" ] && [ "$STALE" = "1" ]; then
+  exit 3
+fi
+
 echo "== dense baseline (allreduce) =="
 $PY benchmarks/train.py --model resnet20 --num_steps $STEPS \
   --grace_config "{'compressor':'none','memory':'none','communicator':'allreduce'}"

@@ -46,7 +46,11 @@ import jax
 import jax.numpy as jnp
 
 from deepreduce_tpu import sparse as _sparse
-from deepreduce_tpu.sparse import SparseGrad
+from deepreduce_tpu.sparse import (  # noqa: F401 — re-exported: profile_codec and tests address these as bloom._*
+    SparseGrad,
+    _prefix_positions,
+    _select_bit,
+)
 
 _LN2 = 0.6931471805599453
 _GOLDEN = 0x9E3779B9
@@ -423,67 +427,6 @@ def query_universe(words: jax.Array, meta: BloomMeta) -> jax.Array:
         return one_chunk(jnp.int32(0))[:d]
     mask = jax.lax.map(one_chunk, jnp.arange(n_chunks, dtype=jnp.int32))
     return mask.reshape(-1)[:d]
-
-
-def _select_bit(word: jax.Array, t: jax.Array) -> jax.Array:
-    """Position of the (t+1)-th set bit of each uint32 `word` — 5-step
-    binary select over popcounts of low halves, fully vectorized."""
-    pos = jnp.zeros_like(t)
-    rem = t
-    for width in (16, 8, 4, 2, 1):
-        low = (word >> pos.astype(jnp.uint32)) & (
-            (jnp.uint32(1) << jnp.uint32(width)) - 1
-        )
-        c = jax.lax.population_count(low).astype(jnp.int32)
-        hi = rem >= c
-        rem = rem - jnp.where(hi, c, 0)
-        pos = pos + jnp.where(hi, width, 0)
-    return pos
-
-
-def _prefix_positions(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Array]:
-    """(positions[budget], count): universe positions of the first `budget`
-    True entries of `mask`, ascending — WITHOUT a d-scale sort or scatter.
-
-    Rank inversion in three cheap moves (the round-3 encode unlock; the
-    round-2 rank-scatter cost ~17ms at d=4M on TPU, this costs ~3ms):
-      1. pack the mask into 32-bit group words; per-group popcounts and
-         their (exclusive) prefix P give every group's first output slot;
-      2. ONE small scatter-add of a marker per group at slot P[g] (parked
-         past `budget` when the group starts beyond it); cumsum of the
-         markers tells each output slot s which group it reads from —
-         g(s) = cumsum[s] - 1, exact even across empty-group runs;
-      3. the in-group bit offset is `_select_bit(word[g], s - P[g])`.
-    Only budget-scale gathers + one G-scale unique-ish scatter-add remain.
-    Dead slots (s >= count) return position clipped into range — callers
-    mask them."""
-    d = mask.shape[0]
-    g_count = (d + 31) // 32
-    padded = (
-        jnp.zeros((g_count * 32,), jnp.uint32).at[:d].set(mask.astype(jnp.uint32))
-    )
-    hw = jnp.sum(
-        padded.reshape(g_count, 32) << jnp.arange(32, dtype=jnp.uint32)[None, :],
-        axis=1,
-    ).astype(jnp.uint32)
-    cnt = jax.lax.population_count(hw).astype(jnp.int32)
-    cs = jnp.cumsum(cnt)
-    p_ex = cs - cnt
-    count = jnp.minimum(cs[-1], budget)
-    markers = (
-        jnp.zeros((budget + 1,), jnp.int32)
-        .at[jnp.minimum(p_ex, budget)]
-        .add(1, indices_are_sorted=True)
-    )
-    g_of_s = jnp.clip(jnp.cumsum(markers)[:budget] - 1, 0, g_count - 1)
-    # g_of_s is non-decreasing by construction (cumsum of non-negative
-    # markers) — sorted gathers let XLA:TPU walk HBM sequentially
-    t = jnp.arange(budget, dtype=jnp.int32) - jnp.take(
-        p_ex, g_of_s, indices_are_sorted=True, mode="clip"
-    )
-    b = _select_bit(jnp.take(hw, g_of_s, indices_are_sorted=True, mode="clip"), t)
-    pos = jnp.clip(g_of_s * 32 + b, 0, d - 1)
-    return pos, count
 
 
 def _prefix_select(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Array]:

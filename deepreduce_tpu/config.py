@@ -17,7 +17,10 @@ from typing import Any, Dict, Optional
 @dataclasses.dataclass(frozen=True)
 class DeepReduceConfig:
     # sparsifier (GRACE 'compressor' role)
-    compressor: str = "topk"  # topk | randomk | threshold | none
+    # topk_sampled = sortless O(d) sampled-quantile top-k (sparse.py
+    # topk_sampled): no top_k/sort over d, nnz <= k dynamic — candidate
+    # replacement for approx_topk on TPU, pending the silicon A/B
+    compressor: str = "topk"  # topk | topk_sampled | randomk | threshold | none
     compress_ratio: float = 0.01
     threshold_val: float = 0.0
     approx_topk: bool = False  # TPU-native approx_max_k sparsifier (~4x faster)

@@ -186,9 +186,16 @@ static ffi::Error BloomCompressImpl(ffi::Buffer<ffi::F32> dense,
   if (n < 0)
     return ffi::Error(ffi::ErrorCode::kInvalidArgument, "bloom compress failed");
   nbytes->typed_data()[0] = n;
-  int32_t ns;
-  std::memcpy(&ns, wire->typed_data() + 8, 4);
-  if (ns > vcap) ns = vcap;
+  int32_t ns = 0;
+  if (n >= 12) {
+    std::memcpy(&ns, wire->typed_data() + 8, 4);
+    // clamp against both the output buffer and the bytes the C core
+    // actually wrote, so format drift can never over-read the wire
+    int32_t wire_max = (n - 12) / 4;
+    if (ns < 0) ns = 0;
+    if (ns > vcap) ns = vcap;
+    if (ns > wire_max) ns = wire_max;
+  }
   std::memcpy(values->typed_data(), wire->typed_data() + 12, (size_t)ns * 4);
   nsel->typed_data()[0] = ns;
   return ffi::Error::Success();

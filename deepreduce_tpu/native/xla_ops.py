@@ -57,7 +57,16 @@ def available() -> bool:
     """True when the FFI route can serve as the production native path:
     CPU platform (the axon TPU PJRT executes no host custom-calls) and the
     library builds/registers. Codecs fall back to `pure_callback` when
-    False."""
+    False.
+
+    Trace-time assumption: this is evaluated once, when the enclosing codec
+    traces, against `jax.default_backend()` — the FFI targets are registered
+    for platform='cpu' only. A program traced on CPU but executed on another
+    platform (explicit device placement, AOT export) would bake in a
+    custom-call the executing platform cannot serve; don't move such traces
+    across platforms. In this repo every entry point pins the platform
+    before tracing (utils.force_platform / conftest), so trace and execute
+    platforms always agree."""
     try:
         if jax.default_backend() != "cpu":
             return False

@@ -52,6 +52,20 @@ def _timed(fn) -> float:
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
+class DensePayload:
+    """Uncompressed-leaf wire format when dense transmission statically wins:
+    the raw tensor, nothing else. Deliberate delta from the reference, whose
+    >min-size gate ships the sparsifier's (values, indices) pair even when
+    that pair exceeds the raw tensor (pytorch/deepreduce.py:68 returns the
+    sparsifier output as-is). Transmitting dense is lossless AND never more
+    than 1.0x, and the decision is static (the slot budget k is static), so
+    jit sees a fixed payload structure. See PARITY.md 'dense fallback'."""
+
+    tensor: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
 class BothPayload:
     """'both' wire format: index payload (values stripped), value payload,
     packed mapping (pytorch/deepreduce.py:267)."""
@@ -120,7 +134,7 @@ class TensorCodec:
             cfg.bloom_threshold_insert
             and cfg.index == "bloom"
             and cfg.deepreduce in ("index", "both")
-            and cfg.compressor not in ("topk", "threshold")
+            and cfg.compressor not in ("topk", "topk_sampled", "threshold")
         ):
             raise ValueError(
                 "bloom_threshold_insert rebuilds the selection as a magnitude "
@@ -145,6 +159,16 @@ class TensorCodec:
                 self.val_codec = get_codec(cfg.value, "value")(self.k, self.d, params)
         # mapping pack width: ceil(log2 k) bits (paper pdf p.46)
         self._map_width = max(1, math.ceil(math.log2(max(2, self.k))))
+        # Real dense-transmission fallback for uncompressed leaves: when the
+        # leaf is never sparsified (compressor 'none', pattern-excluded) or
+        # the static sparse budget pair already costs >= the raw tensor
+        # (k*64 >= d*32 bits), transmit the dense tensor itself. Static
+        # decision -> fixed jit payload structure; the wire accounting below
+        # then reflects what is actually sent.
+        never_sparse = cfg.compressor == "none" or self.pattern_excluded
+        self.dense_fallback = not self.compressed and (
+            never_sparse or self.k * 64 >= self.d * 32
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -154,6 +178,8 @@ class TensorCodec:
             return sparse.none_sparsifier(tensor)
         if cfg.compressor == "topk":
             return sparse.topk(tensor, cfg.compress_ratio, approx=cfg.approx_topk)
+        if cfg.compressor == "topk_sampled":
+            return sparse.topk_sampled(tensor, cfg.compress_ratio)
         if cfg.compressor == "randomk":
             if key is None:
                 raise ValueError("randomk sparsifier needs a PRNG key")
@@ -169,6 +195,8 @@ class TensorCodec:
     ) -> Any:
         """tensor -> payload (the reference's wrapper.compress,
         pytorch/deepreduce.py:250-272)."""
+        if self.dense_fallback:
+            return DensePayload(tensor=tensor)
         sp = self.sparsify(tensor, key=key)
         if not self.compressed:
             return sp
@@ -207,6 +235,8 @@ class TensorCodec:
     def decode(self, payload: Any, *, step: jax.Array = 0) -> jax.Array:
         """payload -> dense tensor (wrapper.decompress,
         pytorch/deepreduce.py:274-302)."""
+        if self.dense_fallback:
+            return payload.tensor.reshape(self.shape)
         if not self.compressed:
             return payload.to_dense()
 
@@ -296,18 +326,16 @@ class TensorCodec:
 
     def wire_stats(self, payload: Any) -> WireStats:
         dense_bits = jnp.asarray(self.d * 32, jnp.float32)
-        if not self.compressed:
+        if self.dense_fallback:
+            # the wire carries exactly the raw tensor: no index stream, 1.0x
+            idx_bits = jnp.zeros(())
+            val_bits = dense_bits
+        elif not self.compressed:
+            # sparse (idx, val) pair actually transmitted; k*64 < d*32 here
+            # (else dense_fallback), so nnz <= k keeps every leaf <= 1.0
             nnz = payload.nnz.astype(jnp.float32)
-            # a dense transmission (no sparsifier, or pattern-excluded layer)
-            # carries no index stream; and a sparse (idx, val) transmission
-            # that would EXCEED the raw tensor falls back to transmitting
-            # dense — the reference's bypass ships the tensor as-is
-            # (pytorch/deepreduce.py:68), so no leaf may account > 1.0
-            dense_tx = self.cfg.compressor == "none" or self.pattern_excluded
-            sparse_beats_dense = nnz * 64 < dense_bits
-            use_sparse = jnp.logical_and(jnp.logical_not(dense_tx), sparse_beats_dense)
-            idx_bits = jnp.where(use_sparse, nnz * 32, 0.0)
-            val_bits = jnp.where(use_sparse, nnz * 32, dense_bits)
+            idx_bits = nnz * 32
+            val_bits = nnz * 32
         elif self.cfg.deepreduce == "value":
             # positional dense transmission (no sparsifier): values arrive in
             # slot order covering the whole tensor — the plain-QSGD wire has

@@ -1,0 +1,58 @@
+"""Pin the per-platform native-codec routing (PARITY.md §2.4).
+
+The FFI custom-call targets are registered for platform='cpu' only; on the
+TPU backend `xla_ops.available()` must be False so `bloom_native` /
+`integer_native` take the `pure_callback` host route — the same host-only
+split the reference has (policies.hpp:43-146 runs conflict_sets on the CPU
+inside the TF op, never on the accelerator). Payload equality between the
+two routes is covered by test_xla_ffi.py; this file covers the gate itself.
+"""
+
+import jax
+import pytest
+
+from deepreduce_tpu.native import xla_ops
+
+
+def test_available_true_only_on_cpu_backend(monkeypatch):
+    try:
+        xla_ops.register()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"ffi unavailable: {e}")
+    assert jax.default_backend() == "cpu"
+    assert xla_ops.available()
+    for backend in ("tpu", "gpu"):
+        monkeypatch.setattr(jax, "default_backend", lambda b=backend: b)
+        assert not xla_ops.available(), (
+            f"FFI route must be gated off on {backend}: the targets are "
+            "registered for platform='cpu' only"
+        )
+
+
+def test_native_codecs_use_callback_off_cpu(monkeypatch):
+    """On a non-CPU backend the native codecs must trace the pure_callback
+    route (no cpu-only custom call baked into the program)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from deepreduce_tpu import sparse
+    from deepreduce_tpu.codecs.registry import get_codec
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    calls = []
+    real_cb = jax.pure_callback
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return real_cb(*args, **kwargs)
+
+    monkeypatch.setattr(jax, "pure_callback", spy)
+    rng = np.random.default_rng(3)
+    d = 20_000
+    g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    sp = sparse.topk(g, 0.01)
+    codec = get_codec("bloom_native", "index")(sp.k, d, {"fpr": 0.02, "policy": "conflict_sets"})
+    payload = codec.encode(sp, dense=g, step=0)
+    out = codec.decode(payload, (d,), step=0)
+    assert calls, "expected the pure_callback host route off-CPU"
+    assert int(out.nnz) > 0

@@ -99,7 +99,7 @@ def measure_config(d, ratio, cfg_kwargs, iters):
     # the measured-best knob set (approx_topk, mod-blocked bloom, fused,
     # pallas) ships as a named preset; every config here runs under it
     cfg = DeepReduceConfig.tpu_defaults(
-        compressor="topk", compress_ratio=ratio, **cfg_kwargs
+        compress_ratio=ratio, **{"compressor": "topk", **cfg_kwargs}
     )
     codec = TensorCodec((d,), cfg, name="bench")
     rng = np.random.default_rng(0)
@@ -459,6 +459,18 @@ def main() -> None:
             deepreduce="both", index="integer", value="qsgd", policy="p0", memory="none"
         ),
         "drqsgd_bloom": dict(
+            deepreduce="both",
+            index="bloom",
+            value="qsgd",
+            policy="p0",
+            fpr=0.02,
+            memory="none",
+        ),
+        # the flagship shape with the sortless sampled-threshold sparsifier
+        # (sparse.topk_sampled) in place of approx_max_k — the candidate
+        # tpu_defaults flip; same wire, cheaper selection
+        "drqsgd_bloom_sampled": dict(
+            compressor="topk_sampled",
             deepreduce="both",
             index="bloom",
             value="qsgd",

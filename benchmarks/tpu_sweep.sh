@@ -35,6 +35,11 @@ run $PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.001 --threshold_insert
 run $PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001
 run $PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --threshold_insert
 run $PY benchmarks/profile_codec.py --d $LSTM_D --index integer
+# sampled-threshold sparsifier A/B: every profile run above already times
+# sparsify_exact/approx/sampled standalone; these two measure the full
+# pipeline with the sampled selection driving the flagship codec
+run $PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --compressor topk_sampled
+run $PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --compressor topk_sampled
 echo "== bench.py (full) ==" >&2
 timeout 3000 $PY bench.py 2>/dev/null | tail -1 >> "$OUT" || echo "(bench failed)" >&2
 echo "sweep done -> $OUT" >&2

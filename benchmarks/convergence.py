@@ -178,9 +178,11 @@ def main():
     ap.add_argument("--seeds", type=int, default=3,
                     help="independent repeats (data+init+order re-drawn); "
                          "suite mode reports mean±std over these")
-    ap.add_argument("--platform", type=str, default="",
-                    help="'cpu' forces the 8-device virtual CPU mesh (env vars "
-                         "alone don't stick under the axon TPU tunnel)")
+    ap.add_argument("--platform", type=str, default="cpu",
+                    help="'cpu' (default) forces the 8-device virtual CPU mesh "
+                         "— accuracy results are platform-independent and the "
+                         "ambient TPU tunnel can hang for hours; pass '' to "
+                         "use the ambient platform")
     args = ap.parse_args()
 
     if args.steps < 1:

@@ -88,8 +88,12 @@ def run_arm(cfg_params, rounds, seed, size=16, classes=10, noise=2.5):
     if cfg is None:
         cfg = DeepReduceConfig(compressor="none", memory="none")
     # momentum restarts every round (client state is not federated), so the
-    # client lr carries the progress; 0.2 reaches the dense plateau in ~25
-    # rounds on this task
+    # client lr carries the progress. At noise 2.5 BOTH arms keep improving
+    # well past 40 rounds (dense 0.63 -> 0.93 between rounds 40 and 120);
+    # an artifact taken mid-convergence measures convergence *speed*, not
+    # the paper's at-convergence parity claim — default rounds below is
+    # sized so both arms plateau (r5: gap 0.0068 at 120 rounds vs the
+    # paper's own 0.0077 at its 800)
     fa = FedAvg(loss_fn, cfg, fed, optax.sgd(0.2, momentum=0.9))
     state = fa.init(params)
     run_round = jax.jit(fa.run_round)
@@ -119,7 +123,7 @@ def run_arm(cfg_params, rounds, seed, size=16, classes=10, noise=2.5):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rounds", type=int, default=40)
+    ap.add_argument("--rounds", type=int, default=120)
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--noise", type=float, default=2.5)
     ap.add_argument("--out", default=None)

@@ -53,7 +53,10 @@ def main():
                     help="user-popularity power-law exponent (ML-20m-like skew)")
     ap.add_argument("--negatives", type=int, default=4,
                     help="uniform negative items per positive (NCF recipe)")
-    ap.add_argument("--platform", type=str, default="")
+    # default cpu: this is an accounting/accuracy harness whose numbers are
+    # platform-independent, and the ambient axon tunnel can hang for hours;
+    # pass --platform '' to use the ambient platform
+    ap.add_argument("--platform", type=str, default="cpu")
     ap.add_argument("--safety", type=float, default=1.25)
     args = ap.parse_args()
 

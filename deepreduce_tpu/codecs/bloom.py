@@ -26,9 +26,12 @@ TPU-first redesign:
   are mask+cumsum prefix selections — sort-free, static-shape. ``random`` is
   keyed by (seed, step) on *both* sides, fixing the reference's re-seeded
   ``manual_seed(42)`` quirk while keeping its cross-worker determinism
-  contract (policies.hpp:160-180 seeds by step). ``conflict_sets`` (P2) is
-  native-only, as in the reference (policies.hpp:43-146) — see
-  `deepreduce_tpu.native`.
+  contract (policies.hpp:160-180 seeds by step). Exact ``conflict_sets``
+  (P2) is native-only, as in the reference (policies.hpp:43-146) — see
+  `deepreduce_tpu.native`; ``conflict_sets_approx`` is the in-graph
+  parallel redesign of the same draw (one lexicographic sort by
+  within-set random rank / set size / tiebreak over the positive pool —
+  `_conflict_sets_select`), jit-native so it runs on TPU.
 - P0's data-dependent output size (|P| >= k) becomes a static budget from
   the paper's Lemma-6 expectation ``|P| <= k + fpr·(d-k)`` with 5% + 64
   headroom; `nsel` is the in-band length word (the reference prepends the
@@ -225,9 +228,13 @@ class BloomMeta:
     ) -> "BloomMeta":
         if policy == "conflict_sets":
             raise NotImplementedError(
-                "conflict_sets (P2) is native-only, as in the reference "
-                "(policies.hpp:43-146); use deepreduce_tpu.native.bloom"
+                "exact conflict_sets (P2) is native-only, as in the reference "
+                "(policies.hpp:43-146): use index='bloom_native' (host "
+                "callback off-CPU), or policy='conflict_sets_approx' for the "
+                "in-graph parallel redesign that runs on TPU"
             )
+        if policy not in ("leftmost", "p0", "random", "conflict_sets_approx"):
+            raise ValueError(f"unknown bloom policy {policy!r}")
         blocked = BloomMeta.normalize_blocked(blocked)
         if blocked:
             m_bits, num_hash, fpr_eff = blocked_bloom_config(k, d, fpr, mode=blocked)
@@ -439,6 +446,69 @@ def _prefix_select(mask: jax.Array, budget: int) -> Tuple[jax.Array, jax.Array]:
     return jnp.where(live, pos, 0), count
 
 
+def _conflict_sets_select(
+    mask: jax.Array, meta: BloomMeta, *, step: jax.Array, seed: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    """In-graph approximation of the reference's P2 conflict-sets policy
+    (policies.hpp:43-146): group positives by the filter bucket whose bits
+    they share, then draw round-robin — one random member per set, smallest
+    sets first — until the budget fills. The reference's sequential
+    smallest-set-first loop becomes a single lexicographic sort over the
+    positive pool by (within-set random rank, set size, random tiebreak):
+    rank-0 rows are exactly "one draw per set", ordered small-sets-first,
+    then rank-1 rows, and so on — the same visit order, computed in
+    parallel (SURVEY.md §7 hard-part 2's 'segment-sort + segmented random
+    pick' redesign). All randomness is keyed by (seed, step) only, so
+    encode and decode derive the identical selection from the identical
+    filter (the policies.hpp:117,172 determinism contract).
+
+    Work is pool-scale (the Lemma-6 positive bound), never d-scale: one
+    `_prefix_positions` over the mask, one histogram scatter-add over the
+    filter words, two pool-length lexsorts."""
+    pool = p0_budget(meta.k, meta.d, meta.fpr)
+    n_groups = meta.m_bits // 32
+    pos, cnt = _prefix_positions(mask, pool)
+    slot = jnp.arange(pool, dtype=jnp.int32)
+    live = slot < cnt
+    g = jnp.where(live, conflict_group(pos, meta), n_groups)
+    # set sizes: scatter-add histogram over words (+1 sentinel for dead)
+    sizes = jnp.zeros((n_groups + 1,), jnp.int32).at[g].add(1, mode="drop")
+    size_of = jnp.where(live, sizes[g], jnp.int32(2**30))
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), jnp.asarray(step, jnp.uint32))
+    r = jax.random.uniform(key, (pool,))
+    # within-set random rank: sort pool rows by (group, r); a row's rank is
+    # its distance from the start of its group run
+    order = jnp.lexsort((r, g))
+    gs = g[order]
+    run_start = jnp.concatenate([jnp.ones((1,), bool), gs[1:] != gs[:-1]])
+    rank_sorted = slot - jax.lax.cummax(jnp.where(run_start, slot, 0))
+    rank = jnp.zeros((pool,), jnp.int32).at[order].set(rank_sorted)
+    rank = jnp.where(live, rank, jnp.int32(2**30))
+    # round-robin visit order: all rank-0 draws (small sets first), then
+    # rank-1, ... — take the first `budget`
+    pick = jnp.lexsort((r, size_of, rank))[: meta.budget]
+    chosen = pos[pick]
+    count = jnp.minimum(cnt, meta.budget)
+    # canonical ascending-index output, dead slots parked at 0
+    out_live = jnp.arange(meta.budget, dtype=jnp.int32) < count
+    chosen = jnp.sort(jnp.where(out_live, chosen, meta.d))
+    return jnp.where(out_live, chosen, 0).astype(jnp.int32), count
+
+
+def conflict_group(indices: jax.Array, meta: BloomMeta) -> jax.Array:
+    """Primary conflict bucket of each index — the word of the filter its
+    bits (or its first hash) land in. Two positives in the same word are
+    exactly the keys whose membership evidence overlaps, the relation the
+    reference's `build_conflict_sets` groups by hash bucket
+    (policies.hpp:43-57); word granularity is that bucket rounded to the
+    filter's physical layout."""
+    if meta.blocked:
+        block, _ = blocked_block_and_mask(indices, meta)
+        return block
+    seeds = hash_seeds(meta.num_hash)
+    return hash_positions(indices, seeds[:1], meta.m_bits)[..., 0] // 32
+
+
 def select(
     mask: jax.Array, meta: BloomMeta, *, step: jax.Array, seed: int = 0
 ) -> Tuple[jax.Array, jax.Array]:
@@ -447,6 +517,8 @@ def select(
     (bloom_filter_compression.cc:217-218)."""
     if meta.policy in ("leftmost", "p0"):
         return _prefix_select(mask, meta.budget)
+    if meta.policy == "conflict_sets_approx":
+        return _conflict_sets_select(mask, meta, step=step, seed=seed)
     if meta.policy == "random":
         key = jax.random.fold_in(jax.random.PRNGKey(seed), jnp.asarray(step, jnp.uint32))
         pri = jax.random.uniform(key, mask.shape)

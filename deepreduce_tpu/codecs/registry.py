@@ -117,7 +117,15 @@ class BloomCodec(Codec):
                 threshold_insert=self.threshold_insert,
             )
         except ValueError as e:
-            raise ValueError(f"bloom_threshold_insert: {e}") from e
+            # threshold_insert's layout requirement is the only ValueError
+            # create() raises when the flag is set AND the policy is valid;
+            # don't misattribute a policy/layout typo to the flag
+            prefix = (
+                "bloom_threshold_insert: "
+                if self.threshold_insert and "policy" not in str(e)
+                else ""
+            )
+            raise ValueError(f"{prefix}{e}") from e
         self.seed = int(self.params.get("seed", 0))
 
     def encode(self, sp, dense=None, *, step=0, key=None):

@@ -40,7 +40,9 @@ class DeepReduceConfig:
     index: str = "bloom"  # bloom | rle | integer | huffman (+ *_native)
     # codec knobs
     fpr: Optional[float] = None  # default 0.1*k/d (pytorch/deepreduce.py:511)
-    policy: str = "leftmost"  # leftmost | random | p0 | conflict_sets(native)
+    # conflict_sets = exact P2, native/host only (as in the reference);
+    # conflict_sets_approx = in-graph parallel P2 redesign, runs on TPU
+    policy: str = "leftmost"  # leftmost | random | p0 | conflict_sets(native) | conflict_sets_approx
     # register-blocked filter (~1.5x filter size for equal FPR): all h bits
     # of a key live in one 32-bit word. False = classic; 'hash' = block by
     # hash (1 gather per universe query); True or 'mod' = block by j mod W,

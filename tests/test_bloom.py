@@ -434,3 +434,83 @@ def test_threshold_insert_config_rejects_non_mod():
         get_codec("bloom", "index")(
             100, 10_000, {"bloom_threshold_insert": True, "bloom_blocked": "hash"}
         )
+
+
+class TestConflictSetsApprox:
+    """In-graph P2 redesign (policies.hpp:43-146 via SURVEY §7 hard-part 2):
+    round-robin one-per-set draw, smallest sets first, step-keyed."""
+
+    def _setup(self, blocked, d=50_000, ratio=0.02, fpr=0.05, seed=5):
+        rng = np.random.default_rng(seed)
+        g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        sp = sparse.topk(g, ratio)
+        meta = bloom.BloomMeta.create(sp.k, d, fpr, "conflict_sets_approx", blocked=blocked)
+        return g, sp, meta
+
+    @pytest.mark.parametrize("blocked", ["mod", False])
+    def test_fp_aware_round_trip_and_determinism(self, blocked):
+        g, sp, meta = self._setup(blocked)
+        d = meta.d
+        pay = jax.jit(lambda s, t: bloom.encode(s, t, meta, step=3))(sp, g)
+        dec = jax.jit(lambda p: bloom.decode(p, meta, (d,), step=3))(pay)
+        nnz = int(dec.nnz)
+        assert nnz == meta.budget == sp.k  # enough positives to fill k
+        idxs = np.asarray(dec.indices)[:nnz]
+        assert (np.diff(idxs) > 0).all()  # canonical ascending, unique
+        # FP-aware: every decoded value equals the dense tensor there
+        np.testing.assert_allclose(
+            np.asarray(dec.values)[:nnz], np.asarray(g)[idxs], rtol=1e-6
+        )
+        # encode/decode bit-agreement: decoder re-derives the identical
+        # selection from the wire alone (policies.hpp:117,172 contract)
+        mask = bloom.query_universe(pay.words, meta)
+        s1, _ = bloom.select(mask, meta, step=jnp.asarray(3))
+        s2, _ = bloom.select(mask, meta, step=jnp.asarray(3))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+        # a different step re-draws: selection changes (randomized policy)
+        s3, _ = bloom.select(mask, meta, step=jnp.asarray(4))
+        assert not np.array_equal(np.asarray(s1), np.asarray(s3))
+
+    def test_round_robin_fairness(self):
+        """Counts per conflict set among the chosen differ by at most 1,
+        except sets exhausted below the fair share — the reference's
+        one-per-set-per-pass visit order (policies.hpp:112-134)."""
+        g, sp, meta = self._setup("mod")
+        pay = bloom.encode(sp, g, meta, step=0)
+        mask = bloom.query_universe(pay.words, meta)
+        chosen, cnt = bloom.select(mask, meta, step=jnp.asarray(0))
+        chosen = np.asarray(chosen)[: int(cnt)]
+        groups = np.asarray(bloom.conflict_group(jnp.asarray(chosen), meta))
+        pos = np.flatnonzero(np.asarray(mask))
+        all_groups = np.asarray(bloom.conflict_group(jnp.asarray(pos), meta))
+        import collections
+
+        csel = collections.Counter(groups.tolist())
+        call = collections.Counter(all_groups.tolist())
+        cmax = max(csel.values())
+        for gid, avail in call.items():
+            took = csel.get(gid, 0)
+            if took < avail:  # not exhausted -> must be within 1 of the max
+                assert took >= cmax - 1, (gid, took, avail, cmax)
+
+    def test_exact_native_p2_still_refuses_jax_route(self):
+        with pytest.raises(NotImplementedError, match="conflict_sets_approx"):
+            bloom.BloomMeta.create(100, 10_000, 0.05, "conflict_sets")
+
+    def test_through_tensor_codec(self):
+        from deepreduce_tpu.config import DeepReduceConfig
+        from deepreduce_tpu.wrappers import TensorCodec
+
+        d = 40_000
+        rng = np.random.default_rng(9)
+        g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        cfg = DeepReduceConfig(
+            deepreduce="index", index="bloom", policy="conflict_sets_approx",
+            compress_ratio=0.02, fpr=0.05, bloom_blocked="mod",
+        )
+        codec = TensorCodec((d,), cfg, name="t")
+        payload = jax.jit(lambda t: codec.encode(t, step=0))(g)
+        out = np.asarray(jax.jit(lambda p: codec.decode(p, step=0))(payload))
+        nz = np.flatnonzero(out)
+        assert len(nz) == codec.k
+        np.testing.assert_allclose(out[nz], np.asarray(g)[nz], rtol=1e-6)

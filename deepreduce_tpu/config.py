@@ -24,6 +24,11 @@ class DeepReduceConfig:
     compress_ratio: float = 0.01
     threshold_val: float = 0.0
     approx_topk: bool = False  # TPU-native approx_max_k sparsifier (~4x faster)
+    # topk_sampled tuning: sample size for the quantile estimate, and the
+    # capture-undershoot factor (expected captures = undershoot*k; lower =
+    # fewer truncation risks / lower recall — sparse.topk_sampled)
+    topk_sample_size: int = 1 << 15
+    topk_undershoot: float = 0.9
     # residual error-feedback (GRACE 'memory' role)
     memory: str = "residual"  # residual | none
     beta: float = 1.0

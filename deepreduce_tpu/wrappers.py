@@ -179,7 +179,12 @@ class TensorCodec:
         if cfg.compressor == "topk":
             return sparse.topk(tensor, cfg.compress_ratio, approx=cfg.approx_topk)
         if cfg.compressor == "topk_sampled":
-            return sparse.topk_sampled(tensor, cfg.compress_ratio)
+            return sparse.topk_sampled(
+                tensor,
+                cfg.compress_ratio,
+                sample_size=cfg.topk_sample_size,
+                undershoot=cfg.topk_undershoot,
+            )
         if cfg.compressor == "randomk":
             if key is None:
                 raise ValueError("randomk sparsifier needs a PRNG key")

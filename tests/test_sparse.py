@@ -222,3 +222,23 @@ def test_topk_sampled_naturally_sparse_falls_back_exact():
     idxs = np.asarray(sp.indices)[: int(sp.nnz)]
     captured = set(idxs.tolist()).intersection(nz.tolist())
     assert len(captured) == 500, f"only {len(captured)}/500 nonzeros captured"
+
+
+def test_topk_sampled_config_knobs_plumb_through():
+    """topk_sample_size / topk_undershoot reach the sparsifier via
+    from_params + TensorCodec; a tighter undershoot captures fewer slots."""
+    from deepreduce_tpu.config import from_params
+    from deepreduce_tpu.wrappers import TensorCodec
+
+    d = 300_000
+    rng = np.random.default_rng(31)
+    g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    nnzs = {}
+    for und in (0.95, 0.6):
+        cfg = from_params({"compressor": "topk_sampled", "compress_ratio": 0.01,
+                           "topk_undershoot": und, "topk_sample_size": 1 << 14})
+        assert cfg.topk_undershoot == und and cfg.topk_sample_size == 1 << 14
+        sp = TensorCodec((d,), cfg, name="t").sparsify(g)
+        nnzs[und] = int(sp.nnz)
+    k = 3000
+    assert 0 < nnzs[0.6] < nnzs[0.95] <= k, nnzs

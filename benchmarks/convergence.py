@@ -129,6 +129,16 @@ SUITE = {
         "policy": "p0", "fpr": 0.02, "bloom_blocked": "mod",
         "min_compress_size": 500,
     },
+    # flagship wire with the sortless sampled-threshold sparsifier; the
+    # small sample bound keeps the sampled path LIVE at this harness's leaf
+    # sizes (the default 32k sample would exact-fallback every leaf here)
+    "drqsgd_bf_p0_sampled": {
+        "compressor": "topk_sampled", "topk_sample_size": 2048,
+        "compress_ratio": 0.1, "memory": "residual",
+        "deepreduce": "both", "index": "bloom", "value": "qsgd",
+        "policy": "p0", "fpr": 0.02, "bloom_blocked": "mod",
+        "min_compress_size": 500,
+    },
     # the repo bench's own headline config (bench.py drqsgd_delta): delta
     # bit-packed indices + QSGD values — convergence-backed like the rest
     "drqsgd_delta": {

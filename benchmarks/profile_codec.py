@@ -24,12 +24,20 @@ import numpy as np
 sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
 
 
-from bench import _sync, _timeit  # noqa: E402 — shared sync + amortized timing
+from bench import _progress, _sync, _timeit  # noqa: E402 — shared sync + amortized timing
 
 
 def amortized(fn, *args, reps: int = 10, iters: int = 4) -> float:
     """One timing protocol for the whole repo: bench._timeit."""
     return _timeit(fn, *args, iters=iters, reps=reps)
+
+
+def _staged(stages: dict, label: str, fn, *args, reps: int) -> None:
+    """Time one stage with progress markers so a wrapper timeout points at
+    the stage that ate the budget, not at the whole run."""
+    _progress(f"stage {label}: timing")
+    stages[label] = amortized(fn, *args, reps=reps)
+    _progress(f"stage {label}: {stages[label] * 1e3:.2f} ms")
 
 
 def main():
@@ -84,8 +92,9 @@ def main():
     geometry = {}
 
     f_sp = jax.jit(lambda t: codec.sparsify(t, key=key))
+    _progress("compiling sparsify")
     sp = _sync(f_sp(g))
-    stages["sparsify"] = amortized(f_sp, g, reps=args.reps)
+    _staged(stages, "sparsify", f_sp, g, reps=args.reps)
 
     # standalone sparsifier A/B at this d/ratio: exact O(d log k) top_k vs
     # TPU approx_max_k vs the sortless sampled-threshold selection
@@ -97,8 +106,9 @@ def main():
         ("sparsify_sampled", lambda t: sparse_mod.topk_sampled(t, args.ratio)),
     ]:
         f = jax.jit(fn)
+        _progress(f"compiling {label}")
         _sync(f(g))
-        stages[label] = amortized(f, g, reps=args.reps)
+        _staged(stages, label, f, g, reps=args.reps)
 
     if args.index == "bloom":
         from deepreduce_tpu.codecs import bloom
@@ -118,24 +128,28 @@ def main():
             thresh = jnp.min(jnp.where(live, jnp.abs(sp.values), jnp.inf))
             assert float(thresh) > 0, "degenerate input: kept zero magnitude"
             f_ins = jax.jit(lambda t, th: bloom.insert_from_dense(t, th, meta))
+            _progress("compiling insert")
             words = _sync(f_ins(g, thresh))
-            stages["insert"] = amortized(f_ins, g, thresh, reps=args.reps)
+            _staged(stages, "insert", f_ins, g, thresh, reps=args.reps)
         else:
             f_ins = jax.jit(lambda i, n: bloom.insert(i, n, meta))
+            _progress("compiling insert")
             words = _sync(f_ins(sp.indices, sp.nnz))
-            stages["insert"] = amortized(f_ins, sp.indices, sp.nnz, reps=args.reps)
+            _staged(stages, "insert", f_ins, sp.indices, sp.nnz, reps=args.reps)
 
         f_qp = jax.jit(
             lambda w: bloom._prefix_positions(bloom.query_universe(w, meta), meta.budget)
         )
+        _progress("compiling query+prefix")
         _sync(f_qp(words))
-        stages["query+prefix"] = amortized(f_qp, words, reps=args.reps)
+        _staged(stages, "query+prefix", f_qp, words, reps=args.reps)
 
         f_be = jax.jit(
             lambda s, t: bloom.encode(s, t, meta, threshold_insert=args.threshold_insert)
         )
+        _progress("compiling bloom.encode")
         bpay = _sync(f_be(sp, g))
-        stages["bloom.encode"] = amortized(f_be, sp, g, reps=args.reps)
+        _staged(stages, "bloom.encode", f_be, sp, g, reps=args.reps)
         # saturation guard (ADVICE r3): nsel == budget means the selection
         # truncated — a threshold-insert A/B would compare different
         # effective selections without this signal
@@ -158,8 +172,9 @@ def main():
                 threshold_insert=args.threshold_insert,
             )
         )
+        _progress("compiling sparsify+bloom.encode")
         _sync(f_sb(g))
-        stages["sparsify+bloom.encode"] = amortized(f_sb, g, reps=args.reps)
+        _staged(stages, "sparsify+bloom.encode", f_sb, g, reps=args.reps)
 
     # index side of the full wrapper encode (sparsify + idx codec, no value
     # codec / payload assembly): encode - encode_idx_only isolates the value
@@ -170,16 +185,19 @@ def main():
                 codec.sparsify(t, key=key), dense=t, step=s, key=key
             )
         )
+        _progress("compiling encode_idx_only")
         _sync(f_ei(g, 0))
-        stages["encode_idx_only"] = amortized(f_ei, g, 1, reps=args.reps)
+        _staged(stages, "encode_idx_only", f_ei, g, 1, reps=args.reps)
 
     f_enc = jax.jit(lambda t, s: codec.encode(t, step=s, key=key))
+    _progress("compiling encode")
     payload = _sync(f_enc(g, 0))
-    stages["encode"] = amortized(f_enc, g, 1, reps=args.reps)
+    _staged(stages, "encode", f_enc, g, 1, reps=args.reps)
 
     f_dec = jax.jit(lambda p, s: codec.decode(p, step=s))
+    _progress("compiling decode")
     _sync(f_dec(payload, 0))
-    stages["decode"] = amortized(f_dec, payload, 1, reps=args.reps)
+    _staged(stages, "decode", f_dec, payload, 1, reps=args.reps)
 
     out = {
         "d": args.d,

@@ -1,0 +1,95 @@
+#!/usr/bin/env bash
+# Tunnel-resilient TPU sweep: probe the device tunnel in a loop and run one
+# measurement arm at a time whenever it is up. Each arm writes its own file
+# under $OUTDIR, so a mid-arm wedge loses only that arm, and completed arms
+# are never rerun (restart-safe). The axon tunnel wedges transiently and
+# recovers within minutes (rounds 3-5 observation) — this script turns a
+# flaky window into a full sweep by outlasting the outages.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUTDIR=${OUTDIR:-tpu_arms_r05}
+PY=${PY:-python}
+ARM_TIMEOUT=${ARM_TIMEOUT:-1800}
+# bench.py's internal TPU child guard is 2400s; its caller deadline must sit
+# above that or a mid-run wedge orphans the child holding the tunnel
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-3000}
+PROBE_SLEEP=${PROBE_SLEEP:-120}
+MAX_TRIES=${MAX_TRIES:-6}
+LSTM_D=4053428
+R50_D=25557032
+mkdir -p "$OUTDIR"
+
+probe() {
+  # one source of truth: the library's subprocess jit-roundtrip probe
+  timeout 120 $PY -c "
+from deepreduce_tpu.utils import device_responsive
+import sys
+sys.exit(0 if device_responsive(timeout_s=90) else 1)"
+}
+
+wait_for_tunnel() {
+  until probe; do
+    echo "$(date +%H:%M:%S) tunnel down; sleeping ${PROBE_SLEEP}s" >&2
+    sleep "$PROBE_SLEEP"
+  done
+  echo "$(date +%H:%M:%S) tunnel up" >&2
+}
+
+# name | command...
+arms() {
+  cat <<EOF
+lstm_fpr02|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02
+lstm_fpr02_ti|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --threshold_insert
+lstm_fpr001|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.001
+lstm_fpr001_ti|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.001 --threshold_insert
+r50_fpr001|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001
+r50_fpr001_ti|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --threshold_insert
+lstm_integer|$PY benchmarks/profile_codec.py --d $LSTM_D --index integer
+lstm_fpr02_sampled|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --compressor topk_sampled
+r50_fpr001_sampled|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --compressor topk_sampled
+bench_full|$PY bench.py
+EOF
+}
+
+while :; do
+  pending=0
+  while IFS='|' read -r name cmd; do
+    out="$OUTDIR/$name.json"
+    tries="$OUTDIR/$name.tries"
+    [ -s "$out" ] && continue
+    n=$(cat "$tries" 2>/dev/null || echo 0)
+    if [ "$n" -ge "$MAX_TRIES" ]; then
+      echo "$name: gave up after $n tries" >&2
+      continue
+    fi
+    pending=1
+    wait_for_tunnel
+    echo $((n + 1)) > "$tries"
+    tmo=$ARM_TIMEOUT
+    [ "$name" = bench_full ] && tmo=$BENCH_TIMEOUT
+    echo "$(date +%H:%M:%S) == $name (try $((n + 1))/$MAX_TRIES, ${tmo}s): $cmd ==" >&2
+    if timeout "$tmo" $cmd > "$out.tmp" 2> "$OUTDIR/$name.log"; then
+      # keep only the final JSON line (progress riding on stdout never
+      # lands in the artifact)
+      grep '^{' "$out.tmp" | tail -1 > "$out"
+      rm -f "$out.tmp"
+      if [ ! -s "$out" ]; then
+        echo "$name: no JSON produced" >&2
+        rm -f "$out"
+      elif grep -q '"degraded_to_cpu": true' "$out"; then
+        # a CPU-degraded bench record is exactly what this sweep exists to
+        # avoid — treat as failure and retry when the tunnel returns
+        echo "$name: degraded to CPU; discarding and retrying" >&2
+        mv "$out" "$OUTDIR/$name.cpu-degraded.json"
+      fi
+      echo "$(date +%H:%M:%S) $name done" >&2
+    else
+      echo "$(date +%H:%M:%S) $name failed/timeout (try $((n + 1)))" >&2
+      rm -f "$out.tmp"
+    fi
+  done < <(arms)
+  [ "$pending" = 0 ] && break
+  sleep 5
+done
+echo "watcher finished -> $OUTDIR" >&2

@@ -166,6 +166,38 @@ def _chip_peak_flops() -> float:
     return 197e12
 
 
+def throughput_cfgs() -> dict:
+    """The two model-throughput arms (dense baseline, flagship topk-1%
+    bloom) — shared with benchmarks/model_throughput_probe.py so the batch
+    sweep measures exactly the configs this bench records."""
+    from deepreduce_tpu.config import DeepReduceConfig
+
+    return {
+        "dense": DeepReduceConfig(
+            compressor="none", deepreduce=None, memory="none", communicator="allreduce"
+        ),
+        "topk1_bloom": DeepReduceConfig.tpu_defaults(
+            compressor="topk", compress_ratio=0.01, memory="residual",
+            deepreduce="index", index="bloom", fpr=0.001,
+        ),
+    }
+
+
+def time_chained_steps(step, state, *, reps: int = 5, rounds: int = 2):
+    """Amortized train-step timing: chain `reps` async step dispatches
+    (each depends on the previous state but none blocks the host), sync
+    once, divide — per-dispatch tunnel overhead amortizes away. Returns
+    (best seconds/step, final state)."""
+    best = float("inf")
+    for i in range(rounds):
+        t0 = time.perf_counter()
+        for r in range(reps):
+            state, _loss, _ = step(state, 1 + i * reps + r)
+        _sync(state.params)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return max(best, 1e-9), state
+
+
 def _model_throughput() -> dict:
     """Full training-step throughput (fwd+bwd+codec+exchange), dense vs
     topk-1% bloom under the tpu_defaults preset, on the single available
@@ -176,7 +208,6 @@ def _model_throughput() -> dict:
     import optax
     from jax.sharding import Mesh
 
-    from deepreduce_tpu.config import DeepReduceConfig
     from deepreduce_tpu.models import ResNet20, ResNet50
     from deepreduce_tpu.train import Trainer
 
@@ -191,15 +222,7 @@ def _model_throughput() -> dict:
         "resnet50": (ResNet50(num_classes=1000, dtype=jnp.bfloat16), (128, 224, 224, 3), 1000),
         "resnet20": (ResNet20(num_classes=10, dtype=jnp.bfloat16), (1024, 32, 32, 3), 10),
     }
-    cfgs = {
-        "dense": DeepReduceConfig(
-            compressor="none", deepreduce=None, memory="none", communicator="allreduce"
-        ),
-        "topk1_bloom": DeepReduceConfig.tpu_defaults(
-            compressor="topk", compress_ratio=0.01, memory="residual",
-            deepreduce="index", index="bloom", fpr=0.001,
-        ),
-    }
+    cfgs = throughput_cfgs()
     out = {}
     for mname, (model, ishape, nclass) in models.items():
         batch = ishape[0]
@@ -215,17 +238,7 @@ def _model_throughput() -> dict:
             step = lambda s, i: trainer.step(s, (images, labels), jax.random.PRNGKey(i))
             state, _, _ = step(state, 0)
             _sync(state.params)
-            # amortized: chain `reps` steps asynchronously (each dispatch
-            # depends on the previous state but none blocks the host), sync
-            # once, divide — per-dispatch tunnel overhead amortizes away
-            reps, best = 5, float("inf")
-            for i in range(2):
-                t0 = time.perf_counter()
-                for r in range(reps):
-                    state, loss, _ = step(state, 1 + i * reps + r)
-                _sync(state.params)
-                best = min(best, (time.perf_counter() - t0) / reps)
-            t_step = max(best, 1e-9)
+            t_step, state = time_chained_steps(step, state)
             entry = {
                 "images_per_sec": round(batch / t_step, 2),
                 "step_time_s": round(t_step, 4),

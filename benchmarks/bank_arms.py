@@ -1,0 +1,99 @@
+"""Collect tunnel_watch arm outputs into one mid-round TPU record.
+
+Reads every ``<name>.json`` under the arms dir (one JSON object per arm, as
+written by `tunnel_watch.sh`), verifies platform, computes the
+threshold-insert and sampled-sparsifier A/B verdicts from the paired arms,
+and writes ``BENCH_TPU_MIDROUND_r05.json``. Run whenever some arms exist —
+re-running with more arms refreshes the record (restart-safe, like the
+watcher).
+
+    python benchmarks/bank_arms.py [--arms tpu_arms_r05] [--out BENCH_TPU_MIDROUND_r05.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import time
+
+
+def _is_tpu(rec: dict) -> bool:
+    """Strict: an arm with a missing platform field does NOT count as TPU."""
+    return rec.get("platform", rec.get("detail", {}).get("platform")) in ("tpu", "axon")
+
+
+def _pair_verdict(arms: dict, base: str, variant: str, stages=("insert", "encode", "decode")) -> dict:
+    """A/B of a paired arm: per-stage ms and the whole-pipeline ratio.
+    Refuses to compare across platforms — a CPU arm paired with a TPU arm
+    would produce a bogus headline ratio."""
+    a, b = arms.get(base), arms.get(variant)
+    if not a or not b:
+        return {"complete": False}
+    if not (_is_tpu(a) and _is_tpu(b)):
+        return {
+            "complete": False,
+            "reason": f"non-TPU side: {[n for n, r in ((base, a), (variant, b)) if not _is_tpu(r)]}",
+        }
+    sa, sb = a["stages_ms"], b["stages_ms"]
+    pipe_a = sa.get("encode", 0) + sa.get("decode", 0)
+    pipe_b = sb.get("encode", 0) + sb.get("decode", 0)
+    out = {
+        "complete": True,
+        "stages_ms": {s: [sa.get(s), sb.get(s)] for s in stages if s in sa or s in sb},
+        "pipeline_ms": [round(pipe_a, 3), round(pipe_b, 3)],
+        "variant_speedup": round(pipe_a / pipe_b, 3) if pipe_b else None,
+    }
+    sat = [n for n, r in ((base, a), (variant, b)) if r.get("meta", {}).get("saturated")]
+    if sat:
+        out["saturated"] = sat
+        out["note"] = f"{'/'.join(sat)} saturated its budget; selections differ — NOT comparable"
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arms", default="tpu_arms_r05")
+    ap.add_argument("--out", default="BENCH_TPU_MIDROUND_r05.json")
+    args = ap.parse_args()
+
+    root = pathlib.Path(__file__).parent.parent
+    arms = {}
+    for p in sorted((root / args.arms).glob("*.json")):
+        if p.name.endswith(".cpu-degraded.json"):
+            continue
+        try:
+            arms[p.stem] = json.loads(p.read_text())
+        except json.JSONDecodeError:
+            continue
+
+    non_tpu = [
+        n for n, r in arms.items()
+        if r.get("platform", r.get("detail", {}).get("platform")) not in (None, "tpu", "axon")
+    ]
+    record = {
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "arms_present": sorted(arms),
+        "non_tpu_arms": non_tpu,
+        "threshold_insert_ab": {
+            k: _pair_verdict(arms, k, f"{k}_ti")
+            for k in ("lstm_fpr02", "lstm_fpr001", "r50_fpr001")
+        },
+        "sampled_sparsifier_ab": {
+            k: _pair_verdict(
+                arms, k, f"{k}_sampled",
+                stages=("sparsify", "sparsify_exact", "sparsify_approx", "sparsify_sampled", "encode", "decode"),
+            )
+            for k in ("lstm_fpr02", "r50_fpr001")
+        },
+        "arms": arms,
+    }
+    (root / args.out).write_text(json.dumps(record, indent=1) + "\n")
+    done = [n for n in record["arms_present"]]
+    print(f"banked {len(done)} arms -> {args.out}: {', '.join(done) or '(none)'}")
+    if non_tpu:
+        print(f"WARNING: non-TPU arms present: {non_tpu}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Standalone single-model training-step throughput probe with a batch knob.
+
+`bench.py`'s model section pins ResNet-50 at batch 128 (the round-3 silicon
+record: 1003 img/s, MFU ~0.062 vs bf16 peak). This probe varies the batch so
+the MFU-vs-batch curve is measurable on the real chip — either a larger
+batch lifts MFU toward the BASELINE.json north star, or the flat curve IS
+the bottleneck analysis (HBM-bound convs / tunnel dispatch, not MXU
+starvation). Same protocol as bench._model_throughput: device-resident
+batch, chained async steps, amortized wall per step, XLA cost-analysis
+flops.
+
+    python benchmarks/model_throughput_probe.py --model resnet50 --batch 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from bench import (  # noqa: E402 — shared presets + protocol with bench's model table
+    _chip_peak_flops,
+    _progress,
+    _step_flops,
+    _sync,
+    throughput_cfgs,
+    time_chained_steps,
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50", choices=["resnet50", "resnet20"])
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--config", default="topk1_bloom", choices=["topk1_bloom", "dense"])
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    if args.platform:
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform(args.platform, device_count=1)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from deepreduce_tpu.models import ResNet20, ResNet50
+    from deepreduce_tpu.train import Trainer
+    from deepreduce_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    rng = np.random.default_rng(0)
+    if args.model == "resnet50":
+        model, hw, nclass = ResNet50(num_classes=1000, dtype=jnp.bfloat16), 224, 1000
+    else:
+        model, hw, nclass = ResNet20(num_classes=10, dtype=jnp.bfloat16), 32, 10
+    cfg = throughput_cfgs()[args.config]
+    images = jnp.asarray(rng.normal(size=(args.batch, hw, hw, 3)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, nclass, args.batch).astype(np.int32))
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    trainer = Trainer(model, cfg, optax.sgd(0.1), mesh)
+    _progress(f"{args.model} b{args.batch} {args.config}: compiling step")
+    state = trainer.init_state(jax.random.PRNGKey(0), (images, labels))
+    step = lambda s, i: trainer.step(s, (images, labels), jax.random.PRNGKey(i))
+    state, _, _ = step(state, 0)
+    _sync(state.params)
+    _progress("timing")
+    t_step, state = time_chained_steps(step, state, reps=args.reps)
+    flops = _step_flops(trainer, state, images, labels)
+    peak = _chip_peak_flops()
+    out = {
+        "model": args.model,
+        "batch": args.batch,
+        "config": args.config,
+        "platform": jax.devices()[0].platform,
+        "images_per_sec": round(args.batch / t_step, 2),
+        "step_time_s": round(t_step, 4),
+    }
+    if flops:
+        out["flops_per_step"] = flops
+        out["mfu_vs_bf16_peak"] = round(flops / t_step / peak, 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

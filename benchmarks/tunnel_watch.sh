@@ -49,6 +49,9 @@ lstm_integer|$PY benchmarks/profile_codec.py --d $LSTM_D --index integer
 lstm_fpr02_sampled|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --compressor topk_sampled
 r50_fpr001_sampled|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --compressor topk_sampled
 bench_full|$PY bench.py
+r50_b256|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 256
+r50_b512|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 512
+r50_b256_dense|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 256 --config dense
 EOF
 }
 
@@ -77,10 +80,11 @@ while :; do
       if [ ! -s "$out" ]; then
         echo "$name: no JSON produced" >&2
         rm -f "$out"
-      elif grep -q '"degraded_to_cpu": true' "$out"; then
-        # a CPU-degraded bench record is exactly what this sweep exists to
-        # avoid — treat as failure and retry when the tunnel returns
-        echo "$name: degraded to CPU; discarding and retrying" >&2
+      elif grep -Eq '"degraded_to_cpu": true|"platform": "(cpu|cuda)"' "$out"; then
+        # a record measured off-TPU (bench's degraded flag, or any arm's
+        # platform field) is exactly what this sweep exists to avoid —
+        # treat as failure and retry when the tunnel returns
+        echo "$name: ran off-TPU; discarding and retrying" >&2
         mv "$out" "$OUTDIR/$name.cpu-degraded.json"
       fi
       echo "$(date +%H:%M:%S) $name done" >&2

@@ -166,6 +166,22 @@ def _chip_peak_flops() -> float:
     return 197e12
 
 
+def throughput_models() -> dict:
+    """name -> (model ctor kwargs applied, image hw, num classes, bench
+    batch) — shared with benchmarks/model_throughput_probe.py so a batch
+    sweep measures exactly the model specs this bench records. bf16 compute
+    dtype (params/grads stay f32, so the codec path is byte-identical): the
+    MXU-native choice."""
+    import jax.numpy as jnp
+
+    from deepreduce_tpu.models import ResNet20, ResNet50
+
+    return {
+        "resnet50": (ResNet50(num_classes=1000, dtype=jnp.bfloat16), 224, 1000, 128),
+        "resnet20": (ResNet20(num_classes=10, dtype=jnp.bfloat16), 32, 10, 1024),
+    }
+
+
 def throughput_cfgs() -> dict:
     """The two model-throughput arms (dense baseline, flagship topk-1%
     bloom) — shared with benchmarks/model_throughput_probe.py so the batch
@@ -208,7 +224,6 @@ def _model_throughput() -> dict:
     import optax
     from jax.sharding import Mesh
 
-    from deepreduce_tpu.models import ResNet20, ResNet50
     from deepreduce_tpu.train import Trainer
 
     import jax.numpy as jnp
@@ -216,16 +231,10 @@ def _model_throughput() -> dict:
     rng = np.random.default_rng(0)
     mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
     peak = _chip_peak_flops()
-    # bf16 compute dtype (params/grads stay f32, so the codec path is
-    # byte-identical): the MXU-native choice, ~19x over f32-at-batch-32
-    models = {
-        "resnet50": (ResNet50(num_classes=1000, dtype=jnp.bfloat16), (128, 224, 224, 3), 1000),
-        "resnet20": (ResNet20(num_classes=10, dtype=jnp.bfloat16), (1024, 32, 32, 3), 10),
-    }
     cfgs = throughput_cfgs()
     out = {}
-    for mname, (model, ishape, nclass) in models.items():
-        batch = ishape[0]
+    for mname, (model, hw, nclass, batch) in throughput_models().items():
+        ishape = (batch, hw, hw, 3)
         # device-resident batch: a host numpy batch would re-cross the
         # tunnel every step and the transfer, not the chip, would be timed
         images = jnp.asarray(rng.normal(size=ishape).astype(np.float32))

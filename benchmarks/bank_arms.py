@@ -67,10 +67,7 @@ def main() -> None:
         except json.JSONDecodeError:
             continue
 
-    non_tpu = [
-        n for n, r in arms.items()
-        if r.get("platform", r.get("detail", {}).get("platform")) not in (None, "tpu", "axon")
-    ]
+    non_tpu = [n for n, r in arms.items() if not _is_tpu(r)]
     record = {
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "arms_present": sorted(arms),
@@ -89,7 +86,7 @@ def main() -> None:
         "arms": arms,
     }
     (root / args.out).write_text(json.dumps(record, indent=1) + "\n")
-    done = [n for n in record["arms_present"]]
+    done = record["arms_present"]
     print(f"banked {len(done)} arms -> {args.out}: {', '.join(done) or '(none)'}")
     if non_tpu:
         print(f"WARNING: non-TPU arms present: {non_tpu}")

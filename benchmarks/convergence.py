@@ -123,6 +123,16 @@ SUITE = {
         "policy": "p0", "fpr": 0.02, "bloom_blocked": "mod",
         "min_compress_size": 500,
     },
+    # the scatter-free insert_from_dense A/B arm (config.bloom_threshold_insert):
+    # inserts the threshold SUPERSET of the top-k (ties join), so the
+    # candidate tpu_defaults flip needs its own convergence evidence, not
+    # just the TPU timing win
+    "bf_p0_index_ti": {
+        "compressor": "topk", "compress_ratio": 0.1, "memory": "residual",
+        "deepreduce": "index", "index": "bloom", "policy": "p0",
+        "fpr": 0.02, "bloom_blocked": "mod", "min_compress_size": 500,
+        "bloom_threshold_insert": True,
+    },
     "drfit_bf_p0": {
         "compressor": "topk", "compress_ratio": 0.1, "memory": "residual",
         "deepreduce": "both", "index": "bloom", "value": "polyfit",

@@ -29,6 +29,7 @@ from bench import (  # noqa: E402 — shared presets + protocol with bench's mod
     _step_flops,
     _sync,
     throughput_cfgs,
+    throughput_models,
     time_chained_steps,
 )
 
@@ -52,16 +53,12 @@ def main() -> None:
     import optax
     from jax.sharding import Mesh
 
-    from deepreduce_tpu.models import ResNet20, ResNet50
     from deepreduce_tpu.train import Trainer
     from deepreduce_tpu.utils import enable_compile_cache
 
     enable_compile_cache()
     rng = np.random.default_rng(0)
-    if args.model == "resnet50":
-        model, hw, nclass = ResNet50(num_classes=1000, dtype=jnp.bfloat16), 224, 1000
-    else:
-        model, hw, nclass = ResNet20(num_classes=10, dtype=jnp.bfloat16), 32, 10
+    model, hw, nclass, _default_batch = throughput_models()[args.model]
     cfg = throughput_cfgs()[args.config]
     images = jnp.asarray(rng.normal(size=(args.batch, hw, hw, 3)).astype(np.float32))
     labels = jnp.asarray(rng.integers(0, nclass, args.batch).astype(np.int32))

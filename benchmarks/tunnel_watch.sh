@@ -52,6 +52,7 @@ bench_full|$PY bench.py
 r50_b256|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 256
 r50_b512|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 512
 r50_b256_dense|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 256 --config dense
+bench_skipmodels|$PY bench.py --skip-models
 EOF
 }
 
@@ -70,7 +71,7 @@ while :; do
     wait_for_tunnel
     echo $((n + 1)) > "$tries"
     tmo=$ARM_TIMEOUT
-    [ "$name" = bench_full ] && tmo=$BENCH_TIMEOUT
+    case "$name" in bench_*) tmo=$BENCH_TIMEOUT ;; esac
     echo "$(date +%H:%M:%S) == $name (try $((n + 1))/$MAX_TRIES, ${tmo}s): $cmd ==" >&2
     if timeout "$tmo" $cmd > "$out.tmp" 2> "$OUTDIR/$name.log"; then
       # keep only the final JSON line (progress riding on stdout never

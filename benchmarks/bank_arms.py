@@ -83,6 +83,15 @@ def main() -> None:
             )
             for k in ("lstm_fpr02", "r50_fpr001")
         },
+        # sparsifier-free direct encode (sampled threshold + threshold insert
+        # fused; bloom.encode_dense_direct) vs the standard approx-topk path
+        "direct_encode_ab": {
+            k: _pair_verdict(
+                arms, k, f"{k}_sampled_ti",
+                stages=("sparsify", "insert", "encode", "decode"),
+            )
+            for k in ("lstm_fpr02", "r50_fpr001")
+        },
         "arms": arms,
     }
     (root / args.out).write_text(json.dumps(record, indent=1) + "\n")

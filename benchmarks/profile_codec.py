@@ -192,6 +192,22 @@ def main():
     f_enc = jax.jit(lambda t, s: codec.encode(t, step=s, key=key))
     _progress("compiling encode")
     payload = _sync(f_enc(g, 0))
+    if getattr(codec, "direct_bloom", False):
+        # the wrapper routed the sparsifier-free encode_dense_direct: its
+        # sampled threshold inserts a superset of the standard path's, so
+        # nsel/saturation must be measured on THIS payload — the standard
+        # bpay's flag above would let a truncated direct selection pass as
+        # comparable (ADVICE-r3 guard, extended to the direct path)
+        nsel_w = int(payload.nsel)
+        geometry["nsel"] = nsel_w
+        geometry["saturated"] = bool(nsel_w >= codec.idx_codec.meta.budget)
+        if geometry["saturated"]:
+            print(
+                "WARNING: direct encode saturated its widened budget "
+                f"(nsel == {codec.idx_codec.meta.budget}); A/B timings are "
+                "NOT comparable",
+                file=sys.stderr,
+            )
     _staged(stages, "encode", f_enc, g, 1, reps=args.reps)
 
     f_dec = jax.jit(lambda p, s: codec.decode(p, step=s))

@@ -48,6 +48,8 @@ r50_fpr001_ti|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.00
 lstm_integer|$PY benchmarks/profile_codec.py --d $LSTM_D --index integer
 lstm_fpr02_sampled|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --compressor topk_sampled
 r50_fpr001_sampled|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --compressor topk_sampled
+lstm_fpr02_sampled_ti|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --compressor topk_sampled --threshold_insert
+r50_fpr001_sampled_ti|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --compressor topk_sampled --threshold_insert
 bench_full|$PY bench.py
 r50_b256|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 256
 r50_b512|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 512

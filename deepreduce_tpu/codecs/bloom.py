@@ -575,17 +575,7 @@ def encode(
     else:
         words = insert(sp.indices, sp.nnz, meta)
     if dense is not None and meta.policy in ("leftmost", "p0"):
-        flat = dense.reshape(-1)
-        mask = query_universe(words, meta)
-        pos, nsel = _prefix_positions(mask, meta.budget)
-        live = jnp.arange(meta.budget, dtype=jnp.int32) < nsel
-        # pos is ascending (rank order): a sorted gather for the FP-aware
-        # value re-read
-        values = jnp.where(
-            live,
-            jnp.take(flat, pos, indices_are_sorted=True, mode="clip"),
-            jnp.zeros((), flat.dtype),
-        )
+        return _fp_aware_payload(words, dense.reshape(-1), meta)
     elif dense is not None:
         mask = query_universe(words, meta)
         selected, nsel = select(mask, meta, step=step, seed=seed)
@@ -599,6 +589,79 @@ def encode(
         values = jnp.zeros((meta.budget,), sp.values.dtype).at[: sp.k].set(sp.values)
         nsel = jnp.minimum(sp.nnz, meta.budget)
     return BloomPayload(values=values, words=words, nsel=nsel.astype(jnp.int32))
+
+
+def _fp_aware_payload(words: jax.Array, flat: jax.Array, meta: BloomMeta) -> BloomPayload:
+    """Shared FP-aware tail of every prefix-policy encode: query the
+    universe, prefix-select the first `budget` positives, and re-read the
+    TRUE dense values at those positions with one ascending (sorted) gather
+    (pytorch/deepreduce.py:519-523). Both `encode` and `encode_dense_direct`
+    must stay bit-identical here — the wire contract is this function."""
+    mask = query_universe(words, meta)
+    pos, nsel = _prefix_positions(mask, meta.budget)
+    live = jnp.arange(meta.budget, dtype=jnp.int32) < nsel
+    values = jnp.where(
+        live,
+        jnp.take(flat, pos, indices_are_sorted=True, mode="clip"),
+        jnp.zeros((), flat.dtype),
+    )
+    return BloomPayload(values=values, words=words, nsel=nsel.astype(jnp.int32))
+
+
+def encode_dense_direct(
+    dense: jax.Array,
+    meta: BloomMeta,
+    *,
+    sample_size: int = 1 << 15,
+    undershoot: float = 0.9,
+) -> BloomPayload:
+    """Sparsifier-free flagship encode: the whole top-k materialization is
+    skipped. The k-th magnitude is estimated from a strided sample
+    (`sparse.sampled_kth_magnitude`), the filter is built straight from the
+    dense tensor by the scatter-free threshold insert, and the FP-aware
+    value stream comes from the usual query -> prefix -> sorted gather.
+
+    Composition of two independently convergence-backed approximations
+    (CONVERGENCE.json `drqsgd_bf_p0_sampled` for the sampled threshold,
+    `bf_p0_index_ti` for the threshold-superset insert); the wire format
+    and decode side are bit-identical to the standard path, so this is an
+    encoder-only optimization — it removes the O(d)-compaction /
+    O(d log k)-sort sparsify stage that dominates encode.
+
+    Requires the 'mod' blocked layout and a prefix policy (leftmost/p0):
+    the selection must be derivable from the filter alone. A zero estimated
+    threshold (naturally sparse tensor the sample missed) falls back to
+    exact top-k insertion under `lax.cond`, mirroring
+    `sparse.topk_sampled`; small tensors take the exact path statically."""
+    if meta.blocked != "mod":
+        raise ValueError("encode_dense_direct requires the 'mod' blocked layout")
+    if meta.policy not in ("leftmost", "p0"):
+        raise ValueError(
+            f"encode_dense_direct needs a prefix policy (leftmost/p0), got {meta.policy!r}"
+        )
+    flat = dense.reshape(-1)
+    d = flat.shape[0]
+
+    def exact_words():
+        _, idxs = jax.lax.top_k(jnp.abs(flat), meta.k)
+        return insert(
+            jnp.sort(idxs).astype(jnp.int32), jnp.asarray(meta.k, jnp.int32), meta
+        )
+
+    if d <= max(4 * meta.k, 2 * sample_size):
+        # small tensor: sampling error would dominate and exact top-k is
+        # cheap — same static guard as sparse.topk_sampled
+        words = exact_words()
+    else:
+        t = _sparse.sampled_kth_magnitude(
+            flat, meta.k, sample_size=sample_size, undershoot=undershoot
+        )
+        words = jax.lax.cond(
+            t > 0,
+            lambda: insert_from_dense(dense, t.astype(dense.dtype), meta),
+            exact_words,
+        )
+    return _fp_aware_payload(words, flat, meta)
 
 
 def decode(

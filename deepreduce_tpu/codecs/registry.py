@@ -138,6 +138,15 @@ class BloomCodec(Codec):
             threshold_insert=self.threshold_insert,
         )
 
+    def encode_direct(self, dense, *, sample_size, undershoot):
+        """Sparsifier-free encode (bloom.encode_dense_direct): the wrapper
+        routes here when the config statically selects the sampled-threshold
+        sparsifier AND the threshold insert — the selection lives entirely
+        in the filter, so no top-k is ever materialized."""
+        return bloom.encode_dense_direct(
+            dense, self.meta, sample_size=sample_size, undershoot=undershoot
+        )
+
     def decode(self, payload, shape, *, step=0):
         return bloom.decode(payload, self.meta, shape, step=step, seed=self.seed)
 

@@ -169,6 +169,19 @@ class TensorCodec:
         self.dense_fallback = not self.compressed and (
             never_sparse or self.k * 64 >= self.d * 32
         )
+        # Sparsifier-free bloom encode (bloom.encode_dense_direct): when the
+        # config statically combines the sampled-threshold sparsifier with
+        # the threshold insert under a prefix policy, the selection lives
+        # entirely in the filter and the top-k materialization is skipped.
+        # Static predicate -> fixed jit graph; decode is unchanged.
+        self.direct_bloom = (
+            self.compressed
+            and cfg.deepreduce in ("index", "both")
+            and cfg.index == "bloom"
+            and cfg.compressor == "topk_sampled"
+            and cfg.bloom_threshold_insert
+            and cfg.policy in ("leftmost", "p0")
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -202,23 +215,33 @@ class TensorCodec:
         pytorch/deepreduce.py:250-272)."""
         if self.dense_fallback:
             return DensePayload(tensor=tensor)
-        sp = self.sparsify(tensor, key=key)
-        if not self.compressed:
-            return sp
-
         mode = self.cfg.deepreduce
-        if mode == "value":
-            return self.val_codec.encode(sp, step=step, key=key)
-        if mode == "index":
-            return self.idx_codec.encode(sp, dense=tensor, step=step, key=key)
+        if self.direct_bloom:
+            # sparsifier-free: the filter IS the selection; no top-k runs
+            ipay = self.idx_codec.encode_direct(
+                tensor,
+                sample_size=self.cfg.topk_sample_size,
+                undershoot=self.cfg.topk_undershoot,
+            )
+            if mode == "index":
+                return ipay
+            nsel = ipay.nsel
+        else:
+            sp = self.sparsify(tensor, key=key)
+            if not self.compressed:
+                return sp
+            if mode == "value":
+                return self.val_codec.encode(sp, step=step, key=key)
+            if mode == "index":
+                return self.idx_codec.encode(sp, dense=tensor, step=step, key=key)
 
-        # both: index codec first (FP-aware), then value codec over the
-        # selected values with fresh arange indices (pytorch/deepreduce.py:261-263)
-        ipay = self.idx_codec.encode(sp, dense=tensor, step=step, key=key)
+            # both: index codec first (FP-aware), then value codec over the
+            # selected values with fresh arange indices (pytorch/deepreduce.py:261-263)
+            ipay = self.idx_codec.encode(sp, dense=tensor, step=step, key=key)
+            nsel = getattr(ipay, "nsel", None)
+            nsel = sp.nnz if nsel is None else nsel
         sel_vals = ipay.values
         vk = sel_vals.shape[0]
-        nsel = getattr(ipay, "nsel", None)
-        nsel = sp.nnz if nsel is None else nsel
         inner = SparseGrad(
             values=sel_vals,
             indices=jnp.arange(vk, dtype=jnp.int32),

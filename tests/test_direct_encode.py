@@ -1,0 +1,142 @@
+"""Sparsifier-free bloom encode (bloom.encode_dense_direct + wrapper routing).
+
+The direct path composes the sampled-threshold selection with the
+scatter-free threshold insert so no top-k is ever materialized; these tests
+pin the invariants that make it wire-compatible with the standard path:
+FP-aware values (every decoded value is the true dense value at its
+position), the exact fallback when the sample sees only zeros, the static
+small-tensor fallback, and the wrapper's static routing predicate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepreduce_tpu.codecs import bloom
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.wrappers import TensorCodec
+
+
+def _meta(d, k, fpr=0.02):
+    return bloom.BloomMeta.create(
+        k, d, fpr=fpr, policy="p0", blocked="mod", threshold_insert=True
+    )
+
+
+class TestEncodeDenseDirect:
+    def test_fp_aware_roundtrip(self):
+        """Every decoded nonzero equals the dense tensor at that position,
+        and the captured set covers ~undershoot*k of the top magnitudes."""
+        d, k = 60_000, 3_000
+        rng = np.random.default_rng(0)
+        g = jnp.asarray((rng.normal(size=d) * rng.random(d) ** 2).astype(np.float32))
+        meta = _meta(d, k)
+        pay = jax.jit(
+            lambda t: bloom.encode_dense_direct(t, meta, sample_size=4096)
+        )(g)
+        nsel = int(pay.nsel)
+        assert 0 < nsel <= meta.budget
+        dec = bloom.decode_dense(pay, meta, (d,))
+        dec = np.asarray(dec)
+        gnp = np.asarray(g)
+        sel = np.nonzero(dec)[0]
+        np.testing.assert_array_equal(dec[sel], gnp[sel])
+        # the selection is a threshold set: it contains the very largest
+        # magnitudes (the top 10% of k can't be missed by a 4096-sample
+        # quantile at undershoot 0.9)
+        top = np.argsort(-np.abs(gnp))[: k // 10]
+        assert np.isin(top, sel).all()
+
+    def test_zero_threshold_falls_back_to_exact(self):
+        """Mass the sample's stride can't see -> t == 0 -> exact top-k
+        branch; the support is fully recovered."""
+        d, k = 50_000, 2_500
+        g = np.zeros(d, np.float32)
+        g[:10] = np.arange(1, 11, dtype=np.float32)  # all mass in 10 slots
+        meta = _meta(d, k)
+        pay = jax.jit(
+            lambda t: bloom.encode_dense_direct(t, meta, sample_size=4096)
+        )(jnp.asarray(g))
+        dec = np.asarray(bloom.decode_dense(pay, meta, (d,)))
+        np.testing.assert_array_equal(dec, g)
+
+    def test_small_tensor_static_exact(self):
+        d, k = 4_000, 200
+        rng = np.random.default_rng(1)
+        g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        meta = _meta(d, k)
+        pay = bloom.encode_dense_direct(g, meta, sample_size=4096)
+        dec = np.asarray(bloom.decode_dense(pay, meta, (d,)))
+        gnp = np.asarray(g)
+        sel = np.nonzero(dec)[0]
+        np.testing.assert_array_equal(dec[sel], gnp[sel])
+        # exact static path: the top-k set itself is selected (plus FPs)
+        top = np.argsort(-np.abs(gnp))[: k // 2]
+        assert np.isin(top, sel).all()
+
+    def test_layout_and_policy_guards(self):
+        m_hash = bloom.BloomMeta.create(100, 10_000, policy="p0", blocked="hash")
+        with pytest.raises(ValueError, match="mod"):
+            bloom.encode_dense_direct(jnp.zeros(10_000), m_hash)
+        m_rand = bloom.BloomMeta.create(
+            100, 10_000, policy="random", blocked="mod"
+        )
+        with pytest.raises(ValueError, match="prefix"):
+            bloom.encode_dense_direct(jnp.zeros(10_000), m_rand)
+
+
+class TestWrapperRouting:
+    CFG = dict(
+        compressor="topk_sampled",
+        compress_ratio=0.05,
+        deepreduce="index",
+        index="bloom",
+        policy="p0",
+        fpr=0.02,
+        bloom_blocked="mod",
+        bloom_threshold_insert=True,
+        topk_sample_size=4096,
+    )
+
+    def test_predicate_and_roundtrip(self):
+        d = 60_000
+        cfg = DeepReduceConfig(**self.CFG)
+        codec = TensorCodec((d,), cfg, name="t")
+        assert codec.direct_bloom
+        rng = np.random.default_rng(2)
+        g = jnp.asarray((rng.normal(size=d) * rng.random(d) ** 2).astype(np.float32))
+        pay = jax.jit(lambda t: codec.encode(t, step=0))(g)
+        dec = np.asarray(jax.jit(lambda p: codec.decode(p, step=0))(pay, ))
+        gnp = np.asarray(g)
+        sel = np.nonzero(dec)[0]
+        np.testing.assert_array_equal(dec[sel], gnp[sel])
+        # wire accounting identical to the standard bloom path
+        stats = codec.wire_stats(pay)
+        assert float(stats.rel_volume()) < 0.25
+
+    def test_both_mode_routes_direct(self):
+        d = 60_000
+        cfg = DeepReduceConfig(
+            **{**self.CFG, "deepreduce": "both", "value": "qsgd"}
+        )
+        codec = TensorCodec((d,), cfg, name="t")
+        assert codec.direct_bloom
+        rng = np.random.default_rng(3)
+        g = jnp.asarray((rng.normal(size=d) * rng.random(d) ** 2).astype(np.float32))
+        pay = jax.jit(lambda t: codec.encode(t, step=0))(g)
+        dec = np.asarray(jax.jit(lambda p: codec.decode(p, step=0))(pay))
+        gnp = np.asarray(g)
+        sel = np.nonzero(dec)[0]
+        assert sel.size > 0
+        # QSGD is lossy: decoded values approximate the true ones
+        err = np.abs(dec[sel] - gnp[sel]) / (np.abs(gnp[sel]).max() + 1e-12)
+        assert float(err.max()) < 0.2
+
+    def test_predicate_off_without_flag(self):
+        cfg = DeepReduceConfig(**{**self.CFG, "bloom_threshold_insert": False})
+        codec = TensorCodec((60_000,), cfg, name="t")
+        assert not codec.direct_bloom
+        cfg2 = DeepReduceConfig(**{**self.CFG, "compressor": "topk"})
+        codec2 = TensorCodec((60_000,), cfg2, name="t")
+        assert not codec2.direct_bloom

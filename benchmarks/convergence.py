@@ -133,6 +133,16 @@ SUITE = {
         "fpr": 0.02, "bloom_blocked": "mod", "min_compress_size": 500,
         "bloom_threshold_insert": True,
     },
+    # the fully fused sparsifier-free encode (bloom.encode_dense_direct):
+    # sampled threshold + threshold insert — TensorCodec.direct_bloom routes
+    # here; convergence evidence for the composition, not just its halves
+    "bf_p0_index_sampled_ti": {
+        "compressor": "topk_sampled", "topk_sample_size": 2048,
+        "compress_ratio": 0.1, "memory": "residual",
+        "deepreduce": "index", "index": "bloom", "policy": "p0",
+        "fpr": 0.02, "bloom_blocked": "mod", "min_compress_size": 500,
+        "bloom_threshold_insert": True,
+    },
     "drfit_bf_p0": {
         "compressor": "topk", "compress_ratio": 0.1, "memory": "residual",
         "deepreduce": "both", "index": "bloom", "value": "polyfit",

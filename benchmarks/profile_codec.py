@@ -180,11 +180,23 @@ def main():
     # codec / payload assembly): encode - encode_idx_only isolates the value
     # codec AND the BothPayload assembly as they run inside the full graph
     if codec.idx_codec is not None:
-        f_ei = jax.jit(
-            lambda t, s: codec.idx_codec.encode(
-                codec.sparsify(t, key=key), dense=t, step=s, key=key
+        if getattr(codec, "direct_bloom", False):
+            # the wrapper's full encode routes the sparsifier-free direct
+            # path — time the same path here or 'encode - encode_idx_only'
+            # would subtract a stage the full graph never runs
+            f_ei = jax.jit(
+                lambda t, s: codec.idx_codec.encode_direct(
+                    t,
+                    sample_size=codec.cfg.topk_sample_size,
+                    undershoot=codec.cfg.topk_undershoot,
+                )
             )
-        )
+        else:
+            f_ei = jax.jit(
+                lambda t, s: codec.idx_codec.encode(
+                    codec.sparsify(t, key=key), dense=t, step=s, key=key
+                )
+            )
         _progress("compiling encode_idx_only")
         _sync(f_ei(g, 0))
         _staged(stages, "encode_idx_only", f_ei, g, 1, reps=args.reps)
@@ -198,9 +210,10 @@ def main():
         # nsel/saturation must be measured on THIS payload — the standard
         # bpay's flag above would let a truncated direct selection pass as
         # comparable (ADVICE-r3 guard, extended to the direct path)
-        nsel_w = int(payload.nsel)
-        geometry["nsel"] = nsel_w
-        geometry["saturated"] = bool(nsel_w >= codec.idx_codec.meta.budget)
+        geometry["nsel"] = int(payload.nsel)
+        geometry["saturated"] = bool(
+            bloom.saturated(payload, codec.idx_codec.meta)
+        )
         if geometry["saturated"]:
             print(
                 "WARNING: direct encode saturated its widened budget "

@@ -500,6 +500,19 @@ def main() -> None:
             fpr=0.02,
             memory="none",
         ),
+        # the fused sparsifier-free encode (bloom.encode_dense_direct):
+        # sampled threshold + scatter-free threshold insert — no top-k
+        # anywhere; same wire, convergence-backed (bf_p0_index_sampled_ti)
+        "drqsgd_bloom_direct": dict(
+            compressor="topk_sampled",
+            deepreduce="both",
+            index="bloom",
+            value="qsgd",
+            policy="p0",
+            fpr=0.02,
+            memory="none",
+            bloom_threshold_insert=True,
+        ),
     }
     measured = {
         name: measure_config(d, ratio, kw, iters) for name, kw in configs.items()

@@ -36,25 +36,27 @@ wait_for_tunnel() {
   echo "$(date +%H:%M:%S) tunnel up" >&2
 }
 
-# name | command...
+# name | command...  — ordered by value-per-minute of tunnel uptime: the
+# two-rounds-overdue threshold-insert A/B first, then the fused direct
+# path, the lean headline bench, the rest, and the model probes last
 arms() {
   cat <<EOF
 lstm_fpr02|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02
 lstm_fpr02_ti|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --threshold_insert
+lstm_fpr02_sampled_ti|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --compressor topk_sampled --threshold_insert
+bench_skipmodels|$PY bench.py --skip-models
 lstm_fpr001|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.001
 lstm_fpr001_ti|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.001 --threshold_insert
 r50_fpr001|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001
 r50_fpr001_ti|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --threshold_insert
+r50_fpr001_sampled_ti|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --compressor topk_sampled --threshold_insert
 lstm_integer|$PY benchmarks/profile_codec.py --d $LSTM_D --index integer
 lstm_fpr02_sampled|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --compressor topk_sampled
 r50_fpr001_sampled|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --compressor topk_sampled
-lstm_fpr02_sampled_ti|$PY benchmarks/profile_codec.py --d $LSTM_D --fpr 0.02 --compressor topk_sampled --threshold_insert
-r50_fpr001_sampled_ti|$PY benchmarks/profile_codec.py --d $R50_D --ratio 0.01 --fpr 0.001 --compressor topk_sampled --threshold_insert
 bench_full|$PY bench.py
 r50_b256|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 256
 r50_b512|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 512
 r50_b256_dense|$PY benchmarks/model_throughput_probe.py --model resnet50 --batch 256 --config dense
-bench_skipmodels|$PY bench.py --skip-models
 EOF
 }
 

@@ -16,6 +16,9 @@ Configs measured:
                       (our best: the FastPFor-role codec, O(k) both sides)
   - drqsgd_bloom    — topk 10% + blocked-bloom indices (P0) + QSGD values
                       (the paper's DRQSGD-BF-P0 shape)
+  - drqsgd_bloom_sampled — same wire, sortless sampled-threshold sparsifier
+  - drqsgd_bloom_direct  — same wire, sparsifier-free fused encode
+                      (bloom.encode_dense_direct: no top-k anywhere)
 
 Headline value = speedup(best config) vs dense; vs_baseline divides by the
 paper's 7.8x, so vs_baseline >= 1.0 means beating the reference's own

@@ -75,6 +75,24 @@ class TestEncodeDenseDirect:
         top = np.argsort(-np.abs(gnp))[: k // 2]
         assert np.isin(top, sel).all()
 
+    def test_small_tensor_bitwise_matches_standard_encode(self):
+        """On the static exact path the direct encode must be BIT-IDENTICAL
+        to the standard encode fed the exact top-k: same inserted set, same
+        filter words, same FP-aware value stream — the wire-compatibility
+        contract _fp_aware_payload exists to enforce."""
+        from deepreduce_tpu import sparse
+
+        d, k = 4_000, 200
+        rng = np.random.default_rng(4)
+        g = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        meta = _meta(d, k)
+        direct = bloom.encode_dense_direct(g, meta, sample_size=4096)
+        sp = sparse.topk(g, k / d)
+        std = bloom.encode(sp, g, meta)
+        np.testing.assert_array_equal(np.asarray(direct.words), np.asarray(std.words))
+        np.testing.assert_array_equal(np.asarray(direct.values), np.asarray(std.values))
+        assert int(direct.nsel) == int(std.nsel)
+
     def test_layout_and_policy_guards(self):
         m_hash = bloom.BloomMeta.create(100, 10_000, policy="p0", blocked="hash")
         with pytest.raises(ValueError, match="mod"):

@@ -315,7 +315,9 @@ def _measured_exchange(degraded: bool) -> dict:
     return out
 
 
-def _exchange_subprocess(d: int, workers: int, pin_cpu: bool, timeout: int) -> dict:
+def _exchange_subprocess(
+    d: int, workers: int, pin_cpu: bool, timeout: int, decode_strategy: str = "loop"
+) -> dict:
     import os
     import subprocess
 
@@ -331,7 +333,7 @@ import json, time, numpy as np
 from deepreduce_tpu.utils import force_platform
 {pin}
 import jax, jax.numpy as jnp
-from jax import shard_map
+from deepreduce_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 from deepreduce_tpu.comm import GradientExchanger
 from deepreduce_tpu.config import DeepReduceConfig
@@ -354,7 +356,8 @@ def timeit(fn, *args, iters=4, reps=6):
     return max(best, 1e-6)
 cfg = DeepReduceConfig.tpu_defaults(
     compressor="topk", compress_ratio=0.10, deepreduce="both",
-    index="bloom", value="qsgd", policy="p0", fpr=0.02, memory="none")
+    index="bloom", value="qsgd", policy="p0", fpr=0.02, memory="none",
+    decode_strategy={decode_strategy!r})
 grads = {{"g": jnp.asarray(np.random.default_rng(0).normal(size=d), jnp.float32)}}
 ex = GradientExchanger(grads, cfg, axis_name="data", num_workers=nw)
 mesh = Mesh(np.array(jax.devices()[:nw]), ("data",))
@@ -369,14 +372,19 @@ sync(agg)
 t = timeit(fn, grads)
 payload = float(np.asarray(wire.total_bits)) / 8.0
 print(json.dumps({{
-    "workers": nw, "t_step_s": round(t, 4),
+    "workers": nw, "decode_strategy": {decode_strategy!r},
+    "t_step_s": round(t, 4),
     "payload_bytes_per_worker": payload,
+    # static per-worker ICI bytes incl. the ring's explicit (W-1)/W hops
+    "wire_bytes_per_worker": ex.payload_bytes(grads),
     "observed_gathered_GBps": round(nw * payload / t / 1e9, 3),
     "dense_equiv_GBps": round(4.0 * d / t / 1e9, 3),
 }}))
 """
     env = dict(os.environ)
-    label = "8-CPU mesh" if pin_cpu else "1-chip self-gather"
+    label = f"{workers}-CPU mesh" if pin_cpu else "1-chip self-gather"
+    if decode_strategy != "loop":
+        label += f" [{decode_strategy}]"
     if pin_cpu:
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = host_device_count_flags(
@@ -401,7 +409,53 @@ print(json.dumps({{
     return {}
 
 
+def decode_strategy_sweep(d: int = LSTM_D, workers: int = 8) -> dict:
+    """The fused-exchange decode-strategy sweep arm: the SAME flagship
+    bloom+qsgd exchange measured under all three cfg.decode_strategy values
+    (loop / vmap / ring) on the virtual CPU mesh — so the loop-vs-batched-
+    vs-overlapped comparison is recorded even while the TPU tunnel is down.
+    CPU relative timings say nothing absolute about ICI overlap, but they
+    do expose the serial-decode tax the loop pays and the ring's kernel
+    count; the on-silicon sweep reuses this arm unchanged."""
+    out = {}
+    for strategy in ("loop", "vmap", "ring"):
+        rec = _exchange_subprocess(
+            d, workers=workers, pin_cpu=True, timeout=900,
+            decode_strategy=strategy,
+        )
+        if rec:
+            out[strategy] = rec
+    return out
+
+
 def main() -> None:
+    if "--decode-sweep" in sys.argv:
+        # standalone sweep mode: CPU-mesh only, one JSON record on stdout
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu")
+        quick = "--quick" in sys.argv
+        d = LSTM_D if not quick else 500_000
+        sweep = decode_strategy_sweep(d=d)
+        import jax
+
+        print(
+            json.dumps(
+                {
+                    "metric": "fused_exchange_decode_strategy_step_time",
+                    "unit": "s",
+                    "platform": "cpu",
+                    "detail": {
+                        "model": "stackoverflow_lstm" if not quick else "quick",
+                        "d": d,
+                        "workers": 8,
+                        "config": "drqsgd_bloom (topk 10%, bloom P0 fpr=0.02, qsgd)",
+                        "strategies": sweep,
+                    },
+                }
+            )
+        )
+        return
     quick = "--quick" in sys.argv
     iters = 3 if quick else 7
 
@@ -594,6 +648,11 @@ def main() -> None:
             detail["measured_exchange"] = _measured_exchange(degraded)
         except Exception as e:  # noqa: BLE001 — headline must still print
             _progress(f"measured exchange failed: {e}")
+        # loop-vs-vmap-vs-ring fused-decode sweep on the CPU mesh
+        try:
+            detail["decode_strategy_sweep"] = decode_strategy_sweep()
+        except Exception as e:  # noqa: BLE001
+            _progress(f"decode strategy sweep failed: {e}")
 
     if not quick and not degraded and "--skip-models" not in sys.argv:
         # (CPU-degraded runs skip this: img/s and MFU of a conv net on the

@@ -33,9 +33,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepreduce_tpu import memory
+from deepreduce_tpu import comm_ring, memory
 from deepreduce_tpu.config import DeepReduceConfig
-from deepreduce_tpu.metrics import WireStats, combine, payload_device_bytes
+from deepreduce_tpu.metrics import (
+    WireStats,
+    combine,
+    payload_device_bytes,
+    ring_wire_bytes,
+)
 from deepreduce_tpu.sparse import per_tensor_key
 from deepreduce_tpu.wrappers import TensorCodec
 
@@ -152,6 +157,8 @@ class GradientExchanger:
             name: jnp.dtype(leaf.dtype) for name, (path, leaf) in zip(self.names, leaves)
         }
         self._layouts: Optional[Dict[str, PayloadLayout]] = None
+        self._offsets: Dict[str, int] = {}
+        self._fused_nbytes = 0
         if cfg.fused and cfg.communicator == "allgather":
             self._layouts = {}
             for name in self.names:
@@ -162,6 +169,16 @@ class GradientExchanger:
                     g_sds,
                 )
                 self._layouts[name] = PayloadLayout(payload_sds)
+                self._offsets[name] = self._fused_nbytes
+                self._fused_nbytes += self._layouts[name].nbytes
+        if cfg.decode_strategy != "loop" and self._layouts is None:
+            raise ValueError(
+                f"decode_strategy={cfg.decode_strategy!r} restructures the "
+                "FUSED allgather decode and would be silently ignored here "
+                f"(communicator={cfg.communicator!r}, fused={cfg.fused}) — "
+                "use fused=True with communicator='allgather', or "
+                "decode_strategy='loop'"
+            )
 
     # ------------------------------------------------------------------ #
 
@@ -281,53 +298,124 @@ class GradientExchanger:
             agg_leaves[name] = total / num_workers
         return agg_leaves, own_leaves
 
+    def _pack_fused(self, payloads) -> jax.Array:
+        """Every tensor's payload bitcast into ONE uint8[B] buffer at the
+        static offsets computed in __init__."""
+        return jnp.concatenate(
+            [self._layouts[n].pack(payloads[n]) for n in self.names]
+        )
+
+    def _decode_fused_row(self, row: jax.Array, step) -> Tuple[jax.Array, ...]:
+        """One worker's uint8[B] fused buffer -> tuple of dense f32 leaves
+        (ordered like self.names). The shared decode program of all three
+        decode strategies — bit-compatibility across strategies is this
+        function being the single source of truth."""
+        out = []
+        for name in self.names:
+            lo = self._offsets[name]
+            p_w = self._layouts[name].unpack(row[lo : lo + self._layouts[name].nbytes])
+            out.append(self.codecs[name].decode(p_w, step=step).astype(jnp.float32))
+        return tuple(out)
+
     def _exchange_fused(
         self, payloads, num_workers, step, *, need_own: bool
     ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
         """TPU-native shape: every tensor's payload bitcast into ONE uint8
-        buffer, ONE all_gather for the whole step (ICI sees a single large
-        transfer instead of ~T latency-bound small ones), then a single
-        fori_loop over workers whose body decodes all tensors. The own-
-        payload decode (for residual error-feedback) is folded into the
-        same loop with a select at w == my_index, so the decode program is
-        traced once, not twice."""
-        layouts = self._layouts
+        buffer, then one of three decode strategies (cfg.decode_strategy):
+
+        - 'loop': ONE all_gather for the whole step (ICI sees a single large
+          transfer instead of ~T latency-bound small ones), then a single
+          fori_loop over workers whose body decodes all tensors. The own-
+          payload decode (for residual error-feedback) is folded into the
+          same loop with a select at w == my_index, so the decode program is
+          traced once, not twice.
+        - 'vmap': same all_gather, but the [W, B] buffer is decoded in
+          groups of cfg.decode_batch workers under jax.vmap — one big
+          batched kernel per group instead of W tiny sequential ones, with
+          grouping bounding the W-way peak-memory blowup the loop avoids.
+        - 'ring': no all_gather; W-1 double-buffered lax.ppermute hops
+          overlap each chunk's transfer with the previous chunk's decode
+          (comm_ring.ring_decode_exchange).
+        """
+        strategy = self.cfg.decode_strategy
+        buf = self._pack_fused(payloads)
+
+        if strategy == "ring":
+            total, own_fin = comm_ring.ring_decode_exchange(
+                buf,
+                lambda row: self._decode_fused_row(row, step),
+                axis_name=self.axis_name,
+                num_workers=num_workers,
+                need_own=need_own,
+            )
+        else:
+            gathered = jax.lax.all_gather(buf, self.axis_name)  # [W, B]
+            decoder = (
+                self._decode_gathered_vmap
+                if strategy == "vmap"
+                else self._decode_gathered_loop
+            )
+            total, own_fin = decoder(gathered, num_workers, step, need_own=need_own)
+
+        agg_leaves = {name: t / num_workers for name, t in zip(self.names, total)}
+        own_leaves = dict(zip(self.names, own_fin)) if need_own else {}
+        return agg_leaves, own_leaves
+
+    def _decode_gathered_loop(
+        self, gathered, num_workers, step, *, need_own: bool
+    ) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...]]:
+        """Sequential fori_loop over gathered workers (the original shape):
+        O(W·d) serial decode on the critical path, but only ONE dense
+        accumulator lives at a time."""
         widx = jax.lax.axis_index(self.axis_name)
-        buf = jnp.concatenate([layouts[n].pack(payloads[n]) for n in self.names])
-        gathered = jax.lax.all_gather(buf, self.axis_name)  # [W, B]
-
-        offsets = {}
-        off = 0
-        for name in self.names:
-            offsets[name] = off
-            off += layouts[name].nbytes
-
         acc0 = tuple(
             jnp.zeros(self.codecs[n].shape, jnp.float32) for n in self.names
         )
-        own0 = (
-            tuple(jnp.zeros(self.codecs[n].shape, jnp.float32) for n in self.names)
-            if need_own
-            else ()
-        )
+        own0 = acc0 if need_own else ()
 
         def body(w, carry):
             acc, own = carry
             row = jax.lax.dynamic_index_in_dim(gathered, w, keepdims=False)
-            new_acc, new_own = [], []
-            for i, name in enumerate(self.names):
-                lo = offsets[name]
-                p_w = layouts[name].unpack(row[lo : lo + layouts[name].nbytes])
-                dec = self.codecs[name].decode(p_w, step=step).astype(jnp.float32)
-                new_acc.append(acc[i] + dec)
-                if need_own:
-                    new_own.append(jnp.where(w == widx, dec, own[i]))
-            return tuple(new_acc), tuple(new_own)
+            decs = self._decode_fused_row(row, step)
+            new_acc = tuple(a + dec for a, dec in zip(acc, decs))
+            new_own = (
+                tuple(jnp.where(w == widx, dec, o) for dec, o in zip(decs, own))
+                if need_own
+                else ()
+            )
+            return new_acc, new_own
 
-        total, own_fin = jax.lax.fori_loop(0, num_workers, body, (acc0, own0))
-        agg_leaves = {name: t / num_workers for name, t in zip(self.names, total)}
-        own_leaves = dict(zip(self.names, own_fin)) if need_own else {}
-        return agg_leaves, own_leaves
+        return jax.lax.fori_loop(0, num_workers, body, (acc0, own0))
+
+    def _decode_gathered_vmap(
+        self, gathered, num_workers, step, *, need_own: bool
+    ) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...]]:
+        """Batched decode: the [W, B] gathered buffer is decoded in static
+        groups of cfg.decode_batch rows under jax.vmap — one wide kernel per
+        group (W/decode_batch launches instead of W sequential programs),
+        with peak memory bounded at decode_batch dense tensors per leaf.
+        The own-payload decode is recovered by a masked sum over each
+        group's rows (adding exact zeros), so the decode program is still
+        traced once (vmapped), never a second unbatched time."""
+        W = int(num_workers)
+        G = max(1, min(int(self.cfg.decode_batch), W))
+        widx = jax.lax.axis_index(self.axis_name)
+        vdec = jax.vmap(lambda row: self._decode_fused_row(row, step))
+        acc = tuple(
+            jnp.zeros(self.codecs[n].shape, jnp.float32) for n in self.names
+        )
+        own = acc if need_own else ()
+        for g0 in range(0, W, G):
+            g1 = min(g0 + G, W)
+            decs = vdec(jax.lax.slice_in_dim(gathered, g0, g1))  # [g, ...] each
+            acc = tuple(a + d.sum(axis=0) for a, d in zip(acc, decs))
+            if need_own:
+                mine = jnp.arange(g0, g1) == widx  # [g] one-hot or all-false
+                own = tuple(
+                    o + (d * mine.reshape((-1,) + (1,) * (d.ndim - 1))).sum(axis=0)
+                    for o, d in zip(own, decs)
+                )
+        return acc, own
 
     def _exchange_sparse_rs(
         self, grads: Any, state: Any, *, step: jax.Array, key: Optional[jax.Array]
@@ -459,4 +547,14 @@ class GradientExchanger:
                 lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)), flat[name]
             )
             total += payload_device_bytes(payload_shape)
+        if self.cfg.decode_strategy == "ring":
+            # explicit W-1 ppermute hops: each forwards the whole fused
+            # buffer, so per-worker wire is (W-1)·B, not the allgather
+            # path's logical injection B
+            if self.num_workers is None:
+                raise ValueError(
+                    "ring payload accounting needs the static mesh size: "
+                    "construct GradientExchanger(..., num_workers=mesh.shape[axis])"
+                )
+            return ring_wire_bytes(total, self.num_workers)
         return total

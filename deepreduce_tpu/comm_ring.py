@@ -1,0 +1,88 @@
+"""Overlapped ring-decode exchange for the fused communicator hot path.
+
+The allgather-shaped fused exchange (`comm.py:_exchange_fused`) realizes
+`allgather -> per-worker decompress -> aggregate` as one bulk collective
+followed by a *sequential* decode loop: communication fully completes
+before any decode starts, and the O(W·d) decode work sits undivided on the
+step critical path. SparCML (arXiv:1802.08021) and Ok-Topk's sparse
+allreduce (arXiv:2201.07598) both get their wins by hiding the gather
+behind per-chunk decode/reduce; this module is that shape for the fused
+uint8 payload buffer.
+
+Structure: W-1 `lax.ppermute` hops around the mesh axis, double-buffered.
+Each round issues the permute of the *next* chunk before decoding the one
+in hand, so XLA can overlap the ICI transfer with the decode+accumulate
+compute (the transfer has no data dependence on the decode, and the async
+collective start/done pair brackets the decode program). Round 0 decodes
+the worker's own payload — which is exactly the decode residual error
+feedback needs — so the own-payload decode falls out for free instead of
+costing a separate traced program or an in-loop select.
+
+Wire accounting: every worker forwards the B-byte fused buffer W-1 times,
+i.e. per-worker wire bytes are (W-1)·B — the (W-1)/W fraction of the total
+gathered volume W·B (`metrics.ring_wire_bytes`). The bulk all_gather's
+*logical* per-worker injection is B; its physical ring implementation moves
+the same (W-1)·B, but XLA owns that schedule — here the hops are explicit,
+so `GradientExchanger.payload_bytes` reports them explicitly.
+
+Numerics: each worker accumulates chunks in its own ring order
+(me, me-1, ..., me-W+1 mod W), so aggregates agree across strategies and
+across workers only up to f32 sum associativity — an order-insensitive sum,
+not a bitwise-replicated one. See ARCHITECTURE.md "Decode strategies".
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+
+
+def _tree_add(a, b):
+    return tuple(x + y for x, y in zip(a, b))
+
+
+def ring_decode_exchange(
+    buf: jax.Array,
+    decode_row: Callable[[jax.Array], Tuple[jax.Array, ...]],
+    *,
+    axis_name: str,
+    num_workers: int,
+    need_own: bool,
+) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...]]:
+    """Ring-exchange the fused uint8 payload `buf` over `axis_name`,
+    decoding and accumulating each arriving chunk.
+
+    `decode_row` maps one worker's uint8[B] buffer to a tuple of dense f32
+    leaves (the per-tensor decodes). Returns `(total, own)`: the elementwise
+    sum of all W workers' decodes, and the own-payload decode (empty tuple
+    when `need_own` is False — it is still computed, as round 0 of the sum).
+
+    `num_workers` must be the concrete mesh-axis size (ppermute needs a
+    static permutation).
+    """
+    W = int(num_workers)
+    own = decode_row(buf)
+    if W == 1:
+        return own, (own if need_own else ())
+
+    perm = [(j, (j + 1) % W) for j in range(W)]
+    send = lambda x: jax.lax.ppermute(x, axis_name, perm)
+
+    # prologue: hop 1 departs while the own payload decodes
+    nxt = send(buf)
+    acc = own
+
+    # rounds 1 .. W-2: issue hop i+1, then decode the chunk from round i.
+    # The permute is issued first so its transfer has no dependence on the
+    # decode program and can run concurrently with it.
+    def body(_i, carry):
+        acc, cur = carry
+        nxt = send(cur)
+        acc = _tree_add(acc, decode_row(cur))
+        return acc, nxt
+
+    acc, last = jax.lax.fori_loop(0, W - 2, body, (acc, nxt))
+    # epilogue: the final chunk has nothing left to forward
+    acc = _tree_add(acc, decode_row(last))
+    return acc, (own if need_own else ())

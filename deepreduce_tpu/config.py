@@ -86,6 +86,24 @@ class DeepReduceConfig:
     # (the reference's shape, one allgather per hook fire,
     # pytorch/deepreduce.py:54-61).
     fused: bool = True
+    # fused-exchange decode strategy (comm.py / comm_ring.py). How the W
+    # gathered payloads become one aggregate:
+    #   'loop' — sequential fori_loop over workers (one decode program per
+    #            iteration; lowest peak memory, O(W*d) serial critical path)
+    #   'vmap' — the gathered [W, B] buffer is decoded in groups of
+    #            `decode_batch` workers under jax.vmap (one batched kernel
+    #            per group; peak memory bounded at decode_batch dense
+    #            tensors instead of W)
+    #   'ring' — no all_gather at all: W-1 lax.ppermute hops over the fused
+    #            uint8 buffer, double-buffered so the permute of chunk w+1
+    #            overlaps the decode+accumulate of chunk w; the own-payload
+    #            decode for residual feedback falls out of step 0 for free
+    # All three produce the same aggregate up to f32 sum associativity
+    # ('ring' accumulates in ring order, which differs per worker).
+    decode_strategy: str = "loop"  # loop | vmap | ring
+    # 'vmap' group size: workers decoded per batched kernel. Bounds the
+    # W-way peak-memory blowup the sequential loop was avoiding.
+    decode_batch: int = 4
     # small-tensor bypass (pytorch/deepreduce.py:68). None = the reference
     # default for the selected codec: 1000 (PyTorch generic gate), or 9000
     # when value='doubleexp' (tensorflow/deepreduce.py:396,426). An explicit
@@ -98,6 +116,15 @@ class DeepReduceConfig:
     layer_pattern: Optional[str] = None
     # observability
     micro_benchmark: bool = False
+
+    def __post_init__(self):
+        if self.decode_strategy not in ("loop", "vmap", "ring"):
+            raise ValueError(
+                f"decode_strategy must be 'loop', 'vmap' or 'ring', got "
+                f"{self.decode_strategy!r}"
+            )
+        if self.decode_batch < 1:
+            raise ValueError(f"decode_batch must be >= 1, got {self.decode_batch}")
 
     @classmethod
     def tpu_defaults(cls, **overrides) -> "DeepReduceConfig":

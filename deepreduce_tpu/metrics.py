@@ -27,6 +27,15 @@ class WireStats:
     index_bits: jax.Array
     value_bits: jax.Array
     dense_bits: jax.Array  # d * 32 (pytorch/deepreduce.py:93)
+    # payload-saturation counter: number of tensor payloads whose selection
+    # filled every budget slot (bloom nsel == budget) this step. A static
+    # budget that chronically saturates silently truncates high-index
+    # large-magnitude entries (bloom's FP-aware prefix read drops by
+    # ascending index) — training runs watch this instead of discovering the
+    # truncation in a loss curve. 0.0 for codecs without a budget notion.
+    saturated: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.zeros((), jnp.float32)
+    )
 
     @property
     def total_bits(self) -> jax.Array:
@@ -49,7 +58,18 @@ def combine(stats: Dict[str, WireStats]) -> WireStats:
         index_bits=sum(s.index_bits for s in vals),
         value_bits=sum(s.value_bits for s in vals),
         dense_bits=sum(s.dense_bits for s in vals),
+        saturated=sum(s.saturated for s in vals),
     )
+
+
+def ring_wire_bytes(buffer_bytes: int, num_workers: int) -> int:
+    """Per-worker wire bytes of the explicit W-1-hop ppermute ring exchange
+    (comm_ring.py): each worker forwards the B-byte fused buffer W-1 times,
+    i.e. (W-1)/W of the total gathered volume W·B. The bulk all_gather path
+    reports B (the worker's logical injection; XLA owns the physical
+    schedule) — the ring's hops are explicit, so they are accounted
+    explicitly."""
+    return int(buffer_bytes) * max(0, int(num_workers) - 1)
 
 
 def payload_device_bytes(payload: Any) -> int:

@@ -29,6 +29,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from deepreduce_tpu.utils.compat import pcast
+
 _NEG_INF = -1e30  # finite floor: keeps exp() well-defined for masked rows
 
 
@@ -89,7 +91,7 @@ def ring_attention(
     perm = [(j, (j + 1) % n) for j in range(n)]
     # fresh accumulators are device-invariant; mark them varying over the
     # ring axis so the fori_loop carry type is stable round-to-round
-    o, m, l = jax.lax.pcast((o, m, l), (axis_name,), to="varying")
+    o, m, l = pcast((o, m, l), (axis_name,), to="varying")
 
     def body(i, carry):
         o, m, l, k_blk, v_blk = carry

@@ -89,6 +89,9 @@ def make_worker_step(
             index_bits=jax.lax.pmean(wire.index_bits.astype(jnp.float32), axis),
             value_bits=jax.lax.pmean(wire.value_bits.astype(jnp.float32), axis),
             dense_bits=wire.dense_bits.astype(jnp.float32),
+            # saturation is a COUNT (summed, not averaged): total saturated
+            # tensor payloads across all workers this step
+            saturated=jax.lax.psum(wire.saturated.astype(jnp.float32), axis),
         )
         new_state = TrainState(
             params=new_params,
@@ -169,7 +172,7 @@ class Trainer:
             return dataclasses.replace(new_state, residuals=None), new_res, loss, wire
 
         res_spec = P(axis) if has_residuals else P()
-        from jax import shard_map
+        from deepreduce_tpu.utils.compat import shard_map
 
         fn = shard_map(
             spmd,

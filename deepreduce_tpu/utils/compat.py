@@ -1,0 +1,47 @@
+"""JAX version compatibility shims.
+
+The codebase targets the jax>=0.8 public surface (`jax.shard_map` with
+`check_vma`, `jax.lax.pcast`), but must also run on the 0.4.x series where
+`shard_map` still lives in `jax.experimental.shard_map` (with the older
+`check_rep` keyword) and `pcast` does not exist. Every entry point and test
+imports `shard_map` / `pcast` from here instead of from jax directly, so a
+jax upgrade or downgrade breaks exactly one module.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:  # jax >= 0.8: top-level export, `check_vma` keyword
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # jax 0.4.x: experimental module, `check_rep` keyword
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+_HAS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map_impl).parameters
+
+
+def shard_map(f, *args, **kwargs):
+    """`jax.shard_map` with the replication-check keyword translated to
+    whatever this jax version spells it (`check_vma` >= 0.8, `check_rep`
+    before)."""
+    if _HAS_CHECK_VMA and "check_rep" in kwargs:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    elif not _HAS_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map_impl(f, *args, **kwargs)
+
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:
+
+    def pcast(x, axis_names, *, to):  # noqa: ARG001 — signature parity
+        """No-op fallback: pre-0.8 jax has no varying/manual type system, so
+        there is nothing to cast (we run shard_map with the replication
+        check disabled anyway)."""
+        return x
+
+
+__all__ = ["shard_map", "pcast"]

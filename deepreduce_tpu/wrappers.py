@@ -174,12 +174,17 @@ class TensorCodec:
         # the threshold insert under a prefix policy, the selection lives
         # entirely in the filter and the top-k materialization is skipped.
         # Static predicate -> fixed jit graph; decode is unchanged.
+        # bloom_blocked == 'mod' is spelled out even though BloomMeta.create
+        # already rejects threshold_insert on non-mod layouts: the routing
+        # condition must be self-contained, not rely on a downstream
+        # constructor raising (ADVICE.md round-5 item 1)
         self.direct_bloom = (
             self.compressed
             and cfg.deepreduce in ("index", "both")
             and cfg.index == "bloom"
             and cfg.compressor == "topk_sampled"
             and cfg.bloom_threshold_insert
+            and cfg.bloom_blocked == "mod"
             and cfg.policy in ("leftmost", "p0")
         )
 
@@ -352,8 +357,23 @@ class TensorCodec:
                 print(f"{k}:{v}")
         return out
 
+    def _saturation(self, index_payload: Any) -> jax.Array:
+        """1.0 when the index payload's selection filled its whole static
+        budget (nsel == budget) — the silent-truncation signal for the
+        threshold-superset encodes (bloom.encode_dense_direct inserts
+        {|g| >= t}; an underestimated t overflows the budget and the
+        FP-aware prefix read then drops high-index large-magnitude entries
+        with no error). Surfaced through WireStats so training runs can
+        watch for chronic overflow (ADVICE.md round-5 item 2)."""
+        budget = getattr(getattr(self.idx_codec, "meta", None), "budget", None)
+        nsel = getattr(index_payload, "nsel", None)
+        if budget is None or nsel is None:
+            return jnp.zeros((), jnp.float32)
+        return (jnp.asarray(nsel, jnp.int32) >= jnp.int32(budget)).astype(jnp.float32)
+
     def wire_stats(self, payload: Any) -> WireStats:
         dense_bits = jnp.asarray(self.d * 32, jnp.float32)
+        saturated = jnp.zeros((), jnp.float32)
         if self.dense_fallback:
             # the wire carries exactly the raw tensor: no index stream, 1.0x
             idx_bits = jnp.zeros(())
@@ -376,13 +396,16 @@ class TensorCodec:
         elif self.cfg.deepreduce == "index":
             idx_bits = self.idx_codec.index_wire_bits(payload)
             val_bits = self.idx_codec.value_wire_bits(payload)
+            saturated = self._saturation(payload)
         else:
             idx_bits = self.idx_codec.index_wire_bits(payload.index_payload)
             if payload.mapping is not None:
                 idx_bits = idx_bits + packing.wire_bits(payload.mapping).astype(jnp.float32)
             val_bits = self.val_codec.value_wire_bits(payload.value_payload)
+            saturated = self._saturation(payload.index_payload)
         return WireStats(
             index_bits=jnp.asarray(idx_bits, jnp.float32),
             value_bits=jnp.asarray(val_bits, jnp.float32),
             dense_bits=dense_bits,
+            saturated=saturated,
         )

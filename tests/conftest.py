@@ -14,7 +14,34 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import functools
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 assert not jax._src.xla_bridge._backends, "jax backend initialized before conftest"
+
+import numpy as np
+import pytest
+
+
+@functools.lru_cache(maxsize=None)
+def shared_mesh(n: int, axis: str = "data"):
+    """One Mesh object per (n, axis) for the whole session. Identical mesh
+    objects let jax's jit cache hit across tests instead of re-tracing the
+    same shard_map program per test module — test helpers import this
+    (`from conftest import shared_mesh`) so their local `_mesh()` wrappers
+    all resolve to the same instance."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    return shared_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    return shared_mesh(4)

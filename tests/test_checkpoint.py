@@ -6,10 +6,11 @@ import pytest
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh
+
 
 import flax.linen as nn
 
+from conftest import shared_mesh
 from deepreduce_tpu import checkpoint
 from deepreduce_tpu.config import DeepReduceConfig
 from deepreduce_tpu.train import Trainer
@@ -22,7 +23,7 @@ class Tiny(nn.Module):
 
 
 def test_train_state_round_trip(tmp_path):
-    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    mesh = shared_mesh(2)
     cfg = DeepReduceConfig(deepreduce=None, compress_ratio=0.25, memory="residual")
     trainer = Trainer(Tiny(), cfg, optax.sgd(0.1), mesh)
     rng = np.random.default_rng(0)

@@ -6,16 +6,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from conftest import shared_mesh
+from deepreduce_tpu.utils.compat import shard_map
+from jax.sharding import PartitionSpec as P
 
 from deepreduce_tpu.comm import GradientExchanger
 from deepreduce_tpu.config import DeepReduceConfig
 
 
 def _mesh(n=4):
-    devs = np.array(jax.devices()[:n])
-    return Mesh(devs, ("data",))
+    return shared_mesh(n)
 
 
 def _worker_grads(n, d=4096, seed=0):
@@ -123,22 +123,26 @@ def test_payload_bytes_static_accounting():
 
 
 @pytest.mark.parametrize(
-    "codec_cfg",
+    "codec_cfg,exact",
     [
-        dict(deepreduce=None, compress_ratio=0.05),
-        dict(deepreduce="index", index="bloom", compress_ratio=0.02, fpr=0.01),
-        dict(deepreduce="both", index="bloom", value="qsgd", policy="p0",
-             compress_ratio=0.05, fpr=0.05, bloom_blocked="mod"),
-        dict(deepreduce="both", index="integer", value="qsgd", policy="p0",
-             compress_ratio=0.05),
-        dict(deepreduce="value", value="polyfit", compress_ratio=0.05),
+        (dict(deepreduce=None, compress_ratio=0.05), True),
+        (dict(deepreduce="index", index="bloom", compress_ratio=0.02, fpr=0.01), True),
+        (dict(deepreduce="both", index="bloom", value="qsgd", policy="p0",
+              compress_ratio=0.05, fpr=0.05, bloom_blocked="mod"), True),
+        (dict(deepreduce="both", index="integer", value="qsgd", policy="p0",
+              compress_ratio=0.05), True),
+        # polyfit decode is a polynomial evaluation whose reassociation XLA
+        # is free to change between the two programs — tight tolerance, not
+        # bit identity
+        (dict(deepreduce="value", value="polyfit", compress_ratio=0.05), False),
     ],
     ids=["topr", "bloom-index", "modbloom-qsgd-both", "integer-qsgd-both",
          "polyfit-value"],
 )
-def test_fused_matches_per_tensor(codec_cfg):
-    """The fused one-buffer exchange is bit-identical to the reference-shaped
-    per-tensor exchange: same payload bytes cross the wire, same decode."""
+def test_fused_matches_per_tensor(codec_cfg, exact):
+    """The fused one-buffer exchange matches the reference-shaped per-tensor
+    exchange: same payload bytes cross the wire, same decode (bit-identical
+    for every codec whose decode has a fixed evaluation order)."""
     mesh = _mesh()
     grads_w = _worker_grads(4, d=4096, seed=9)
     base = dict(memory="residual", min_compress_size=100, **codec_cfg)
@@ -148,8 +152,13 @@ def test_fused_matches_per_tensor(codec_cfg):
     agg_u, res_u, vol_u, _ = _run_exchange(
         DeepReduceConfig(fused=False, **base), grads_w, mesh
     )
-    np.testing.assert_array_equal(agg_f, agg_u)
-    np.testing.assert_array_equal(
+    assert_close = (
+        np.testing.assert_array_equal
+        if exact
+        else lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    )
+    assert_close(agg_f, agg_u)
+    assert_close(
         np.asarray(jax.tree_util.tree_leaves(res_f)[0]),
         np.asarray(jax.tree_util.tree_leaves(res_u)[0]),
     )

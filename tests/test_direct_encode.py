@@ -93,6 +93,29 @@ class TestEncodeDenseDirect:
         np.testing.assert_array_equal(np.asarray(direct.values), np.asarray(std.values))
         assert int(direct.nsel) == int(std.nsel)
 
+    def test_small_tensor_bitwise_matches_threshold_insert_encode(self):
+        """Companion to the scatter-insert comparison above: the PRODUCTION
+        standard path under this config runs threshold_insert=True
+        (insert_from_dense), whose inserted set is {|g| >= t} — on a
+        TIE-FREE input that set equals the exact top-k set, so the two
+        encodes must be bit-identical there too (ties are the only benign
+        divergence between the inserts; ADVICE.md round-5 item 3)."""
+        from deepreduce_tpu import sparse
+
+        d, k = 4_000, 200
+        rng = np.random.default_rng(5)
+        # tie-free by construction: distinct magnitudes everywhere
+        mags = np.argsort(rng.permutation(d)).astype(np.float32) + 1.0
+        g = jnp.asarray(np.where(rng.random(d) < 0.5, mags, -mags) / d)
+        assert np.unique(np.abs(np.asarray(g))).size == d  # no magnitude ties
+        meta = _meta(d, k)
+        direct = bloom.encode_dense_direct(g, meta, sample_size=4096)
+        sp = sparse.topk(g, k / d)
+        std = bloom.encode(sp, g, meta, threshold_insert=True)
+        np.testing.assert_array_equal(np.asarray(direct.words), np.asarray(std.words))
+        np.testing.assert_array_equal(np.asarray(direct.values), np.asarray(std.values))
+        assert int(direct.nsel) == int(std.nsel)
+
     def test_layout_and_policy_guards(self):
         m_hash = bloom.BloomMeta.create(100, 10_000, policy="p0", blocked="hash")
         with pytest.raises(ValueError, match="mod"):

@@ -11,6 +11,7 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     import __graft_entry__ as ge
 
@@ -25,6 +26,7 @@ def test_entry_compiles_single_chip():
     assert out is not None
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_under_ambient_axon_config():
     """The driver's exact call pattern: a fresh interpreter where the axon
     sitecustomize has already set jax_platforms='axon' (no conftest CPU

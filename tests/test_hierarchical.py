@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from deepreduce_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepreduce_tpu.config import DeepReduceConfig

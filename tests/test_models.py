@@ -10,7 +10,14 @@ import pytest
 
 @pytest.mark.parametrize(
     "name,num_classes",
-    [("ResNet20", 10), ("DenseNet40", 10), ("MobileNetV1", 10), ("VGG16", 10)],
+    [
+        ("ResNet20", 10),
+        # DenseNet40's concatenative graph is ~3x the compile time of the
+        # other families — slow tier only
+        pytest.param("DenseNet40", 10, marks=pytest.mark.slow),
+        ("MobileNetV1", 10),
+        ("VGG16", 10),
+    ],
 )
 def test_image_model_forward(name, num_classes):
     import deepreduce_tpu.models as zoo

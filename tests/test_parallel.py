@@ -7,8 +7,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from conftest import shared_mesh
+from deepreduce_tpu.utils.compat import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepreduce_tpu.parallel import (
     bert_tp_rules,
@@ -28,7 +29,7 @@ def _qkv(b=2, s=64, h=4, d=8, seed=0):
 
 
 def _seq_mesh(n):
-    return Mesh(np.array(jax.devices()[:n]), ("seq",))
+    return shared_mesh(n, "seq")
 
 
 @pytest.mark.parametrize("causal", [False, True])

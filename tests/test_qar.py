@@ -8,8 +8,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from conftest import shared_mesh
+from deepreduce_tpu.utils.compat import shard_map
+from jax.sharding import PartitionSpec as P
 
 from deepreduce_tpu import qar
 from deepreduce_tpu.config import DeepReduceConfig
@@ -19,7 +20,7 @@ D = 6000  # deliberately NOT a multiple of W*bucket
 
 
 def _mesh():
-    return Mesh(np.array(jax.devices()[:W]), ("data",))
+    return shared_mesh(W)
 
 
 def _run_qar(grads, key, bucket=512):

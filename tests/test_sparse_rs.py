@@ -7,8 +7,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from conftest import shared_mesh
+from deepreduce_tpu.utils.compat import shard_map
+from jax.sharding import PartitionSpec as P
 
 from deepreduce_tpu import sparse, sparse_rs
 from deepreduce_tpu.comm import GradientExchanger
@@ -18,7 +19,7 @@ W = 8
 
 
 def _mesh():
-    return Mesh(np.array(jax.devices()[:W]), ("data",))
+    return shared_mesh(W)
 
 
 def _run(flat_w, ratio, headroom, out_headroom=1.0):

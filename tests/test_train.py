@@ -7,10 +7,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax.sharding import Mesh
+
 
 import flax.linen as nn
 
+from conftest import shared_mesh
 from deepreduce_tpu.config import DeepReduceConfig
 from deepreduce_tpu.train import Trainer
 
@@ -34,7 +35,7 @@ def _data(n=512, dim=16, classes=4, seed=0):
 
 
 def _fit(cfg, steps=30, batch=64, lr=0.1, seed=0):
-    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    mesh = shared_mesh(4)
     model = TinyMLP()
     trainer = Trainer(model, cfg, optax.sgd(lr), mesh)
     x, y = _data(seed=seed)
@@ -51,7 +52,10 @@ def _fit(cfg, steps=30, batch=64, lr=0.1, seed=0):
 
 def test_dense_baseline_learns():
     cfg = DeepReduceConfig(communicator="allreduce", memory="none", deepreduce=None, compressor="none")
-    losses, _, wire = _fit(cfg)
+    # 60 steps: the 4-worker SGD run crosses the 0.6 ratio around step 40
+    # on this fixture (0.61 at 30, 0.48 at 60) — give the strict threshold
+    # a real margin instead of loosening it
+    losses, _, wire = _fit(cfg, steps=60)
     assert losses[-1] < 0.6 * losses[0]
     assert float(wire.rel_volume()) == pytest.approx(1.0)
 

@@ -1,0 +1,19 @@
+.PHONY: analyze analyze-quick test test-quick
+
+# full static-analysis gate: AST lint + jaxpr audit of every registered
+# codec/communicator config; writes ANALYSIS.json, exits nonzero on any
+# violation. CPU-only, trace-only (no compiles).
+analyze:
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
+
+# the tier-1 subset (flagship codec/query + the three fused decode
+# strategies) — what tests/test_analysis.py also runs
+analyze-quick:
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis --quick --out -
+
+# tier-1: the fast suite CI gates on (see ROADMAP.md for the full command)
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+test-quick:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q

@@ -255,7 +255,7 @@ def main():
     d_std = float(np.std(list(dense_accs.values())))
 
     def run_config(params, params_doc):
-        cfg = from_params(params)
+        cfg = from_params(params, strict=True)
         accs, gaps, rel_volume = [], [], None
         for s in seeds:
             train, evalset = tasks[s]

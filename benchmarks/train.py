@@ -162,7 +162,9 @@ def run(args) -> dict:
     from deepreduce_tpu.train import Trainer
 
     params = ast.literal_eval(args.grace_config) if args.grace_config else {}
-    cfg = from_params(params)
+    # CLI-entered dicts get the strict treatment: a typo'd knob should kill
+    # the run, not silently bench the default
+    cfg = from_params(params, strict=True)
     model, (kind, spec, classes) = MODELS[args.model]()
 
     n_dev = min(args.num_workers, len(jax.devices()))

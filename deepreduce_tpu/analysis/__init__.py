@@ -1,0 +1,10 @@
+"""Static-analysis gate: jaxpr invariant auditor + repo AST lint.
+
+`python -m deepreduce_tpu.analysis` runs both passes, writes ANALYSIS.json,
+and exits nonzero on any violation. tests/test_analysis.py wraps the fast
+subset into tier-1.
+"""
+
+from deepreduce_tpu.analysis.rules import AuditContext, Violation, run_rules
+
+__all__ = ["AuditContext", "Violation", "run_rules"]

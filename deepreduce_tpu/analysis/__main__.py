@@ -1,0 +1,77 @@
+"""CLI gate: `python -m deepreduce_tpu.analysis [--quick] [--out PATH]`.
+
+Runs the AST lint over the repo and the jaxpr audit over every registered
+codec/communicator config (or the tier-1 quick subset), writes a
+deterministic ANALYSIS.json report, and exits 1 if anything violated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m deepreduce_tpu.analysis",
+        description="jaxpr invariant audit + repo AST lint",
+    )
+    parser.add_argument(
+        "--quick",
+        action="store_true",
+        help="audit only the tier-1 subset (flagship codec/query + the "
+        "three fused decode strategies)",
+    )
+    parser.add_argument(
+        "--out",
+        type=Path,
+        default=None,
+        help="report path (default: ANALYSIS.json at the repo root; '-' "
+        "to skip writing)",
+    )
+    args = parser.parse_args(argv)
+
+    from deepreduce_tpu.analysis.ast_lint import lint_repo
+    from deepreduce_tpu.analysis.jaxpr_audit import audit_all
+
+    root = Path(__file__).resolve().parents[2]
+    ast_violations = lint_repo(root)
+    records, jaxpr_violations = audit_all(quick=args.quick)
+
+    violations = ast_violations + jaxpr_violations
+    skipped = [r.label for r in records if r.skipped is not None]
+    report = {
+        "quick": args.quick,
+        "ast_lint": {
+            "violations": [v.to_dict() for v in ast_violations],
+        },
+        "jaxpr_audit": {
+            "traces": [r.to_dict() for r in records],
+            "violations": [v.to_dict() for v in jaxpr_violations],
+        },
+        "summary": {
+            "traces": len(records),
+            "skipped": skipped,
+            "violations": len(violations),
+        },
+    }
+
+    out_path = args.out if args.out is not None else root / "ANALYSIS.json"
+    if str(out_path) != "-":
+        out_path.write_text(json.dumps(report, indent=2, sort_keys=True) + "\n")
+        print(f"wrote {out_path}")
+
+    print(
+        f"analysis: {len(records)} traces audited"
+        + (f" ({len(skipped)} skipped: {', '.join(skipped)})" if skipped else "")
+        + f", {len(ast_violations)} lint + {len(jaxpr_violations)} jaxpr violations"
+    )
+    for v in violations:
+        print(f"  [{v.rule}] {v.where}: {v.detail}", file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""Repo-specific AST lint — the source-level half of the analysis gate.
+
+Three rules, each pinned to the scope where the hazard is real:
+
+- ``ast-compat-route`` (repo-wide): `shard_map` / `pcast` must be imported
+  from `deepreduce_tpu.utils.compat`, never from `jax.experimental.*`
+  directly. The shim is what keeps the tree collecting across the jax
+  versions we straddle; one direct import reintroduces the 0.4.37
+  collection failure the shim exists to absorb.
+- ``ast-host-entropy`` (traced modules): no `np.random.*`, no global
+  `random.*` seeding, no `time.time()` in code that runs under trace.
+  Host entropy inside a traced function is baked in at trace time — the
+  program silently stops being a function of its inputs.
+- ``ast-traced-branch`` (codec modules): no Python `if`/`while` whose test
+  is a `jnp.*`/`jax.lax.*`/`jax.numpy.*` call. Under trace that raises a
+  TracerBoolConversionError at best; at worst (concrete sub-values) it
+  bakes a data-dependent branch into what must be a static program.
+
+Pure stdlib `ast`; no jax import, so this pass runs anywhere in
+milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional
+
+from deepreduce_tpu.analysis.rules import Violation
+
+R_AST_COMPAT = "ast-compat-route"
+R_AST_ENTROPY = "ast-host-entropy"
+R_AST_BRANCH = "ast-traced-branch"
+
+# the one module allowed to touch jax.experimental.shard_map directly
+COMPAT_MODULE = "deepreduce_tpu/utils/compat.py"
+
+# modules whose function bodies execute under jax trace (host-side tooling
+# like tracking.py / bench drivers is deliberately NOT here)
+TRACED_MODULES = (
+    "deepreduce_tpu/codecs/",
+    "deepreduce_tpu/sparse.py",
+    "deepreduce_tpu/comm.py",
+    "deepreduce_tpu/comm_ring.py",
+    "deepreduce_tpu/memory.py",
+    "deepreduce_tpu/qar.py",
+    "deepreduce_tpu/sparse_rs.py",
+    "deepreduce_tpu/wrappers.py",
+)
+
+# modules where a Python branch on an array value is always a bug
+CODEC_MODULES = (
+    "deepreduce_tpu/codecs/",
+    "deepreduce_tpu/sparse.py",
+    "deepreduce_tpu/wrappers.py",
+)
+
+_ENTROPY_CHAINS = (
+    ("time", "time"),
+    ("np", "random"),
+    ("numpy", "random"),
+    ("random", "seed"),
+    ("random", "random"),
+    ("random", "randint"),
+    ("random", "uniform"),
+    ("random", "choice"),
+    ("random", "shuffle"),
+)
+
+_TRACED_CALL_HEADS = ("jnp", "lax")
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """`np.random.seed` -> ["np", "random", "seed"]; [] if not a pure chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _in_scope(relpath: str, scopes) -> bool:
+    return any(relpath == s or relpath.startswith(s) for s in scopes)
+
+
+def _shard_map_import_violations(tree: ast.AST, relpath: str) -> List[Violation]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            names = [a.name for a in node.names]
+            bad = mod.startswith("jax.experimental") and (
+                "shard_map" in mod or "shard_map" in names or "pcast" in names
+            )
+            if bad:
+                out.append(
+                    Violation(
+                        R_AST_COMPAT,
+                        f"{relpath}:{node.lineno}",
+                        f"direct `from {mod} import {', '.join(names)}` — route "
+                        "shard_map/pcast through deepreduce_tpu.utils.compat",
+                    )
+                )
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax.experimental.shard_map"):
+                    out.append(
+                        Violation(
+                            R_AST_COMPAT,
+                            f"{relpath}:{node.lineno}",
+                            f"direct `import {a.name}` — route shard_map through "
+                            "deepreduce_tpu.utils.compat",
+                        )
+                    )
+    return out
+
+
+def _entropy_violations(tree: ast.AST, relpath: str) -> List[Violation]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if len(chain) < 2:
+            continue
+        for head, second in _ENTROPY_CHAINS:
+            if chain[0] == head and chain[1] == second:
+                out.append(
+                    Violation(
+                        R_AST_ENTROPY,
+                        f"{relpath}:{node.lineno}",
+                        f"host entropy `{'.'.join(chain)}(...)` in traced module — "
+                        "thread a jax PRNG key (or hoist to untraced setup)",
+                    )
+                )
+                break
+    return out
+
+
+def _has_traced_call(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[0] in _TRACED_CALL_HEADS:
+                return True
+            if len(chain) >= 2 and chain[0] == "jax" and chain[1] in ("numpy", "lax"):
+                return True
+    return False
+
+
+def _traced_branch_violations(tree: ast.AST, relpath: str) -> List[Violation]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While)) and _has_traced_call(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            out.append(
+                Violation(
+                    R_AST_BRANCH,
+                    f"{relpath}:{node.lineno}",
+                    f"Python `{kind}` on a traced-array expression in a codec "
+                    "module — use jnp.where / lax.cond / lax.select",
+                )
+            )
+    return out
+
+
+def lint_source(src: str, relpath: str) -> List[Violation]:
+    """Lint one module's source. `relpath` is repo-relative with forward
+    slashes; it selects which rule scopes apply."""
+    tree = ast.parse(src, filename=relpath)
+    out: List[Violation] = []
+    if relpath != COMPAT_MODULE:
+        out.extend(_shard_map_import_violations(tree, relpath))
+    if _in_scope(relpath, TRACED_MODULES):
+        out.extend(_entropy_violations(tree, relpath))
+    if _in_scope(relpath, CODEC_MODULES):
+        out.extend(_traced_branch_violations(tree, relpath))
+    return out
+
+
+def lint_file(path: Path, root: Path) -> List[Violation]:
+    relpath = path.relative_to(root).as_posix()
+    return lint_source(path.read_text(), relpath)
+
+
+def lint_repo(root: Optional[Path] = None) -> List[Violation]:
+    """Lint every python module under deepreduce_tpu/, tests/, and
+    benchmarks/ (compat-route is repo-wide; the other rules scope
+    themselves)."""
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    out: List[Violation] = []
+    for sub in ("deepreduce_tpu", "tests", "benchmarks"):
+        base = root / sub
+        if not base.is_dir():
+            continue
+        for path in sorted(base.rglob("*.py")):
+            out.extend(lint_file(path, root))
+    return out

@@ -1,0 +1,415 @@
+"""Pluggable jaxpr invariant rules — the checkable form of ARCHITECTURE.md.
+
+Every performance claim in this repro is a *structural* property of the
+traced program: static payload shapes, a gather-free mod-blocked bloom
+query, sorted/unique budget-scale gathers and scatters, one collective per
+step on the fused path, no f64 anywhere near the hot loop, host callbacks
+only in the explicitly-host codecs. Ok-Topk (arXiv:2201.07598) and SparCML
+(arXiv:1802.08021) locate the whole win in the operator/collective
+structure of the exchange — so these rules pin that structure down where
+end-to-end timings cannot: at trace time, on any host, with no compile.
+
+Each rule is a function ``rule(closed_jaxpr, ctx) -> list[Violation]`` that
+emits AT MOST ONE aggregated violation per trace (counts ride in the
+detail), so a negative fixture maps to exactly one finding with a distinct
+rule id. `walk_eqns` recurses through every sub-jaxpr a primitive carries
+(shard_map / pjit / scan / while / cond / custom_* / ...), so nothing hides
+inside a loop body.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+import re
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+# ---------------------------------------------------------------------- #
+# rule ids — one per distinct invariant; tests assert on these exact ids
+# ---------------------------------------------------------------------- #
+
+R_F64 = "jx-f64"
+R_DYNAMIC_SHAPE = "jx-dynamic-shape"
+R_UNSORTED_BUDGET_GATHER = "jx-unsorted-budget-gather"
+R_GATHER_IN_MOD_QUERY = "jx-gather-in-mod-query"
+R_COLLECTIVE_COUNT = "jx-collective-count"
+R_WIRE_ACCOUNTING = "jx-wire-accounting"
+R_CALLBACK = "jx-callback"
+R_RETRACE = "jx-retrace"  # emitted by the audit harness (two-trace hash)
+
+ALL_RULE_IDS = (
+    R_F64,
+    R_DYNAMIC_SHAPE,
+    R_UNSORTED_BUDGET_GATHER,
+    R_GATHER_IN_MOD_QUERY,
+    R_COLLECTIVE_COUNT,
+    R_WIRE_ACCOUNTING,
+    R_CALLBACK,
+    R_RETRACE,
+)
+
+# collectives the inventory tracks (jax primitive names as they appear in
+# jaxprs); anything else moving data across the mesh axis would be a new
+# primitive and should be added here deliberately
+COLLECTIVE_PRIMS = (
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "pshuffle",
+    "psum",
+    "psum_scatter",
+    "reduce_scatter",
+    "pmax",
+    "pmin",
+    "pbroadcast",
+)
+
+CALLBACK_PRIMS = ("pure_callback", "io_callback", "callback")
+
+_GATHER_PRIMS = ("gather",)
+_SCATTER_PRIMS = ("scatter", "scatter-add", "scatter-mul", "scatter-min", "scatter-max")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One broken invariant: the rule id, which trace it broke in, and an
+    aggregated human-readable detail (counts, first offending eqn)."""
+
+    rule: str
+    where: str
+    detail: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"rule": self.rule, "where": self.where, "detail": self.detail}
+
+
+@dataclasses.dataclass
+class AuditContext:
+    """Per-trace knobs for the rule set.
+
+    `budget_scale` arms the sorted-gather rule: any gather/scatter moving at
+    least that many indices is "budget-scale" and must be annotated
+    (`indices_are_sorted` for gathers; sorted OR `unique_indices` for
+    scatters — every shipped budget-scale scatter is a unique-index
+    scatter). `forbid_gather` is the mod-blocked query trace's zero-gather
+    contract. `expect_collectives` maps primitive name -> exact static eqn
+    count; listed-or-tracked primitives not in the dict must not appear.
+    `wire_mode`/`expected_wire_bytes` cross-check collective operand sizes
+    against `GradientExchanger.payload_bytes`."""
+
+    label: str
+    allow_callbacks: bool = False
+    budget_scale: Optional[int] = None
+    forbid_gather: bool = False
+    expect_collectives: Optional[Dict[str, int]] = None
+    wire_mode: Optional[str] = None  # 'allgather' | 'ring'
+    expected_wire_bytes: Optional[int] = None
+    num_workers: Optional[int] = None
+
+
+# ---------------------------------------------------------------------- #
+# jaxpr traversal
+# ---------------------------------------------------------------------- #
+
+
+def _subjaxprs(value: Any) -> Iterator[Any]:
+    """Yield every (open) Jaxpr reachable from one eqn param value."""
+    items = value if isinstance(value, (list, tuple)) else (value,)
+    for item in items:
+        if hasattr(item, "eqns"):  # open Jaxpr
+            yield item
+        else:
+            inner = getattr(item, "jaxpr", None)  # ClosedJaxpr
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner
+
+
+def walk_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Depth-first over every eqn, including all nested sub-jaxprs
+    (shard_map/pjit/scan/while/cond bodies). Accepts a Jaxpr or
+    ClosedJaxpr."""
+    inner = getattr(jaxpr, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        jaxpr = inner
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _subjaxprs(v):
+                yield from walk_eqns(sub)
+
+
+def _avals(eqn: Any) -> Iterator[Any]:
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            yield aval
+
+
+def _aval_bytes(aval: Any) -> int:
+    n = int(math.prod(int(s) for s in aval.shape)) if aval.shape else 1
+    return n * np.dtype(aval.dtype).itemsize
+
+
+def _index_count(eqn: Any) -> int:
+    """Number of indexed positions a gather/scatter touches: the index
+    operand's shape with the trailing index-vector dim dropped."""
+    aval = getattr(eqn.invars[1], "aval", None)
+    if aval is None or not getattr(aval, "shape", None):
+        return 1
+    shape = aval.shape
+    lead = shape[:-1] if len(shape) > 1 else shape
+    return int(math.prod(int(s) for s in lead)) if lead else 1
+
+
+def jaxpr_hash(jaxpr: Any) -> str:
+    """Stable content hash of a traced program — two traces of the same
+    step must agree (the retrace/recompile guard). Object addresses inside
+    callback/function reprs (`... at 0x7f...>`) are masked so the hash is
+    also stable across processes and the baseline ANALYSIS.json diffs
+    clean."""
+    text = re.sub(r"0x[0-9a-fA-F]+", "0x", str(jaxpr))
+    return hashlib.sha256(text.encode()).hexdigest()[:16]
+
+
+def collective_counts(jaxpr: Any) -> Dict[str, int]:
+    """Static eqn count per collective primitive (loop bodies count once —
+    the *program* has one collective op there, however many trips run)."""
+    counts: Dict[str, int] = {}
+    for eqn in walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+# ---------------------------------------------------------------------- #
+# rules
+# ---------------------------------------------------------------------- #
+
+
+def rule_no_f64(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """TPUs have no fast f64; ARCHITECTURE.md pins every fit/codec to f32.
+    Any float64/complex128 aval in the traced program is a violation."""
+    bad: List[str] = []
+    for eqn in walk_eqns(jaxpr):
+        for aval in _avals(eqn):
+            dt = getattr(aval, "dtype", None)
+            if dt is None:
+                continue
+            try:
+                npdt = np.dtype(dt)
+            except TypeError:
+                continue  # extended dtypes (PRNG key<fry>) — not numeric
+            if npdt in (np.dtype(np.float64), np.dtype(np.complex128)):
+                bad.append(eqn.primitive.name)
+                break
+    if not bad:
+        return []
+    return [
+        Violation(
+            R_F64,
+            ctx.label,
+            f"{len(bad)} eqn(s) carry float64/complex128 values "
+            f"(first: {bad[0]}); the TPU hot path is f32-only",
+        )
+    ]
+
+
+def rule_static_shapes(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """Every aval dim must be a concrete int — dynamic/polymorphic shapes
+    under jit would mean per-step recompiles (the reference's
+    tensors_size_are_same=False world the whole design exists to avoid)."""
+    bad: List[str] = []
+    for eqn in walk_eqns(jaxpr):
+        for aval in _avals(eqn):
+            dims = getattr(aval, "shape", ())
+            if any(not isinstance(d, (int, np.integer)) for d in dims):
+                bad.append(f"{eqn.primitive.name}:{dims}")
+                break
+    if not bad:
+        return []
+    return [
+        Violation(
+            R_DYNAMIC_SHAPE,
+            ctx.label,
+            f"{len(bad)} eqn(s) have non-static dims (first: {bad[0]})",
+        )
+    ]
+
+
+def rule_sorted_budget_ops(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """Budget-scale gathers must declare indices_are_sorted=True (XLA skips
+    the bounds-sort); budget-scale scatters must be sorted or unique
+    (unsorted+non-unique serializes on collision handling). Armed only when
+    ctx.budget_scale is set — the hot-path configs where the annotations
+    are load-bearing."""
+    if ctx.budget_scale is None:
+        return []
+    bad: List[str] = []
+    for eqn in walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in _GATHER_PRIMS:
+            if _index_count(eqn) >= ctx.budget_scale and not eqn.params.get(
+                "indices_are_sorted", False
+            ):
+                bad.append(f"{name}[n={_index_count(eqn)}]")
+        elif name in _SCATTER_PRIMS:
+            if (
+                _index_count(eqn) >= ctx.budget_scale
+                and not eqn.params.get("indices_are_sorted", False)
+                and not eqn.params.get("unique_indices", False)
+            ):
+                bad.append(f"{name}[n={_index_count(eqn)}]")
+    if not bad:
+        return []
+    return [
+        Violation(
+            R_UNSORTED_BUDGET_GATHER,
+            ctx.label,
+            f"{len(bad)} budget-scale gather/scatter eqn(s) lack "
+            f"indices_are_sorted/unique_indices (first: {bad[0]}; "
+            f"threshold n>={ctx.budget_scale})",
+        )
+    ]
+
+
+def rule_gather_free(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """The bloom_blocked='mod' universe query is a pure broadcast —
+    ARCHITECTURE.md's 'zero gathers' claim, checked literally."""
+    if not ctx.forbid_gather:
+        return []
+    n = sum(1 for eqn in walk_eqns(jaxpr) if eqn.primitive.name in _GATHER_PRIMS)
+    if n == 0:
+        return []
+    return [
+        Violation(
+            R_GATHER_IN_MOD_QUERY,
+            ctx.label,
+            f"{n} gather eqn(s) in a trace contracted to be gather-free "
+            "(mod-blocked bloom query is a broadcast membership test)",
+        )
+    ]
+
+
+def rule_collective_inventory(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """The fused path is exactly ONE all_gather per step; the ring path is
+    ppermute-only; the dense baseline is one psum. Any extra collective is
+    a silent regression of the latency story."""
+    if ctx.expect_collectives is None:
+        return []
+    got = collective_counts(jaxpr)
+    diffs = []
+    for prim in sorted(set(COLLECTIVE_PRIMS) | set(ctx.expect_collectives)):
+        want = ctx.expect_collectives.get(prim, 0)
+        have = got.get(prim, 0)
+        if want != have:
+            diffs.append(f"{prim}: want {want}, got {have}")
+    if not diffs:
+        return []
+    return [
+        Violation(
+            R_COLLECTIVE_COUNT,
+            ctx.label,
+            "collective inventory mismatch — " + "; ".join(diffs),
+        )
+    ]
+
+
+def rule_wire_accounting(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """Cross-check what the collectives actually move against
+    `GradientExchanger.payload_bytes()`: allgather mode sums all_gather
+    operand bytes; ring mode requires every ppermute hop to forward the
+    B-byte fused buffer with (W-1)*B == payload_bytes."""
+    if ctx.wire_mode is None or ctx.expected_wire_bytes is None:
+        return []
+    if ctx.wire_mode == "allgather":
+        moved = sum(
+            _aval_bytes(eqn.invars[0].aval)
+            for eqn in walk_eqns(jaxpr)
+            if eqn.primitive.name == "all_gather"
+        )
+        if moved == ctx.expected_wire_bytes:
+            return []
+        return [
+            Violation(
+                R_WIRE_ACCOUNTING,
+                ctx.label,
+                f"all_gather operands move {moved} B/worker but "
+                f"payload_bytes() reports {ctx.expected_wire_bytes} B",
+            )
+        ]
+    if ctx.wire_mode == "ring":
+        w = ctx.num_workers
+        hop_sizes = {
+            _aval_bytes(eqn.invars[0].aval)
+            for eqn in walk_eqns(jaxpr)
+            if eqn.primitive.name == "ppermute"
+        }
+        if not hop_sizes:
+            return [
+                Violation(
+                    R_WIRE_ACCOUNTING, ctx.label, "ring trace contains no ppermute hops"
+                )
+            ]
+        if len(hop_sizes) > 1:
+            return [
+                Violation(
+                    R_WIRE_ACCOUNTING,
+                    ctx.label,
+                    f"ring hops forward different buffer sizes: {sorted(hop_sizes)}",
+                )
+            ]
+        b = hop_sizes.pop()
+        want = ctx.expected_wire_bytes
+        if w is not None and b * (w - 1) == want:
+            return []
+        return [
+            Violation(
+                R_WIRE_ACCOUNTING,
+                ctx.label,
+                f"ring hop buffer is {b} B; (W-1)*B = {b * ((w or 1) - 1)} B "
+                f"!= payload_bytes() {want} B",
+            )
+        ]
+    raise ValueError(f"unknown wire_mode {ctx.wire_mode!r}")
+
+
+def rule_callback_whitelist(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """Host callbacks stall the device; they are allowed only in the
+    explicitly-host codecs (bloom_native / integer_native / polyfit_host /
+    huffman / gzip). Anywhere else, a pure_callback sneaking into the hot
+    path is a violation."""
+    if ctx.allow_callbacks:
+        return []
+    n = sum(1 for eqn in walk_eqns(jaxpr) if eqn.primitive.name in CALLBACK_PRIMS)
+    if n == 0:
+        return []
+    return [
+        Violation(
+            R_CALLBACK,
+            ctx.label,
+            f"{n} host-callback eqn(s) outside the whitelisted host codecs",
+        )
+    ]
+
+
+JAXPR_RULES = (
+    rule_no_f64,
+    rule_static_shapes,
+    rule_sorted_budget_ops,
+    rule_gather_free,
+    rule_collective_inventory,
+    rule_wire_accounting,
+    rule_callback_whitelist,
+)
+
+
+def run_rules(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """Run every jaxpr rule over one traced program."""
+    out: List[Violation] = []
+    for rule in JAXPR_RULES:
+        out.extend(rule(jaxpr, ctx))
+    return out

@@ -117,7 +117,38 @@ class DeepReduceConfig:
     # observability
     micro_benchmark: bool = False
 
+    # the documented enumerations (comments above + codecs/registry.py).
+    # __post_init__ checks against these so a typo like
+    # communicator='allgater' fails at construction with the valid set in
+    # the message, not three layers deep inside a trace.
+    COMPRESSORS = ("topk", "topk_sampled", "randomk", "threshold", "none")
+    MEMORIES = ("residual", "none")
+    COMMUNICATORS = ("allgather", "allreduce", "qar", "sparse_rs")
+    DEEPREDUCE_MODES = (None, "value", "index", "both")
+    VALUE_CODECS = ("polyfit", "polyfit_host", "polyseg", "doubleexp", "qsgd", "gzip")
+    INDEX_CODECS = ("bloom", "bloom_native", "integer_native", "rle", "integer",
+                    "huffman")
+    POLICIES = ("leftmost", "random", "p0", "conflict_sets", "conflict_sets_approx")
+    BLOOM_BLOCKED = (False, True, "hash", "mod")
+
     def __post_init__(self):
+        def check(name, value, allowed):
+            if value not in allowed:
+                raise ValueError(
+                    f"{name} must be one of {allowed}, got {value!r}"
+                )
+
+        check("compressor", self.compressor, self.COMPRESSORS)
+        check("memory", self.memory, self.MEMORIES)
+        check("communicator", self.communicator, self.COMMUNICATORS)
+        check("deepreduce", self.deepreduce, self.DEEPREDUCE_MODES)
+        check("policy", self.policy, self.POLICIES)
+        # value/index are only consulted when the deepreduce wrapper engages
+        # that side, but an invalid name is a typo in every mode — reject it
+        # before it becomes a KeyError inside the registry
+        check("value", self.value, self.VALUE_CODECS)
+        check("index", self.index, self.INDEX_CODECS)
+        check("bloom_blocked", self.bloom_blocked, self.BLOOM_BLOCKED)
         if self.decode_strategy not in ("loop", "vmap", "ring"):
             raise ValueError(
                 f"decode_strategy must be 'loop', 'vmap' or 'ring', got "
@@ -165,14 +196,25 @@ _KEY_MAP = {
 }
 
 
-def from_params(params: Dict[str, Any]) -> DeepReduceConfig:
+def from_params(params: Dict[str, Any], *, strict: bool = False) -> DeepReduceConfig:
     """Build a config from a reference-style params dict
     (`deepreduce_from_params` role, pytorch/deepreduce.py:28-48). Unknown
-    keys are ignored, like the reference's dict.get discipline."""
+    keys are ignored, like the reference's dict.get discipline — unless
+    `strict=True`, which raises on any key that would be dropped (the
+    bench/CLI entrypoints use strict so a misspelled knob fails loudly
+    instead of silently running the default)."""
     fields = {f.name for f in dataclasses.fields(DeepReduceConfig)}
     kwargs = {}
+    dropped = []
     for key, val in params.items():
         key = _KEY_MAP.get(key, key)
         if key in fields:
             kwargs[key] = val
+        else:
+            dropped.append(key)
+    if strict and dropped:
+        known = sorted(fields | set(_KEY_MAP))
+        raise ValueError(
+            f"unknown config key(s) {sorted(dropped)}; known keys: {known}"
+        )
     return DeepReduceConfig(**kwargs)

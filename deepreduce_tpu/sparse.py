@@ -127,7 +127,9 @@ def topk(
         _, idxs = jax.lax.top_k(jnp.abs(flat), k)
     if sort_indices:
         idxs = jnp.sort(idxs)
-    vals = flat[idxs]
+    # the ascending sort above is a promise XLA can only exploit if the
+    # gather carries it (jx-unsorted-budget-gather pins this)
+    vals = jnp.take(flat, idxs, indices_are_sorted=sort_indices)
     return SparseGrad(
         values=vals,
         indices=idxs.astype(jnp.int32),
@@ -303,7 +305,7 @@ def randomk(
     _, idxs = jax.lax.top_k(priorities, k)
     if sort_indices:
         idxs = jnp.sort(idxs)
-    vals = flat[idxs]
+    vals = jnp.take(flat, idxs, indices_are_sorted=sort_indices)
     return SparseGrad(
         values=vals,
         indices=idxs.astype(jnp.int32),

@@ -1,0 +1,283 @@
+"""The analysis gate, in tier-1: the shipped tree must audit clean (AST
+lint repo-wide + the quick jaxpr subset), and each rule must actually fire
+— every known-bad fixture here is caught with exactly one violation
+carrying its distinct rule id."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepreduce_tpu.analysis import ast_lint, rules
+from deepreduce_tpu.analysis.ast_lint import lint_repo, lint_source
+from deepreduce_tpu.analysis.jaxpr_audit import (
+    AXIS,
+    audit_all,
+    audit_mesh,
+    audit_mod_query,
+    trace_and_check,
+)
+from deepreduce_tpu.analysis.rules import AuditContext, run_rules
+from deepreduce_tpu.config import DeepReduceConfig, from_params
+from deepreduce_tpu.utils.compat import shard_map
+
+
+def _only(violations, rule):
+    """Assert exactly one violation and that it carries `rule`."""
+    assert len(violations) == 1, [v.to_dict() for v in violations]
+    assert violations[0].rule == rule
+    return violations[0]
+
+
+# ---------------------------------------------------------------------- #
+# the shipped tree is clean
+# ---------------------------------------------------------------------- #
+
+
+def test_repo_ast_lint_clean():
+    assert lint_repo() == []
+
+
+def test_quick_jaxpr_audit_clean():
+    records, violations = audit_all(quick=True)
+    assert violations == [], [v.to_dict() for v in violations]
+    assert not any(r.skipped for r in records)
+    labels = {r.label for r in records}
+    assert "query:bloom-mod" in labels
+    assert {"exchange:fused-loop", "exchange:fused-vmap",
+            "exchange:fused-ring"} <= labels
+
+
+def test_mod_query_is_gather_free():
+    """The flagship structural claim, checked on its own: zero gather eqns
+    in the mod-blocked universe query."""
+    (rec,) = audit_mod_query()
+    assert rec.violations == []
+
+
+# ---------------------------------------------------------------------- #
+# AST negative fixtures
+# ---------------------------------------------------------------------- #
+
+
+def test_ast_catches_direct_shard_map_import():
+    src = "from jax.experimental.shard_map import shard_map\n"
+    _only(lint_source(src, "deepreduce_tpu/newmod.py"), ast_lint.R_AST_COMPAT)
+
+
+def test_ast_catches_host_entropy_in_traced_module():
+    src = (
+        "import numpy as np\n"
+        "def encode(x):\n"
+        "    noise = np.random.normal(size=x.shape)\n"
+        "    return x + noise\n"
+    )
+    _only(lint_source(src, "deepreduce_tpu/codecs/fake.py"), ast_lint.R_AST_ENTROPY)
+
+
+def test_ast_catches_time_in_traced_module():
+    src = "import time\n\ndef encode(x):\n    return x * time.time()\n"
+    _only(lint_source(src, "deepreduce_tpu/sparse.py"), ast_lint.R_AST_ENTROPY)
+
+
+def test_ast_catches_python_branch_on_traced_value():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def decode(x):\n"
+        "    if jnp.max(x) > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    _only(lint_source(src, "deepreduce_tpu/codecs/fake.py"), ast_lint.R_AST_BRANCH)
+
+
+def test_ast_rules_scope_correctly():
+    # host entropy is fine in untraced tooling; compat module may import
+    # shard_map directly (it IS the shim)
+    src = "import time\nt = time.time()\n"
+    assert lint_source(src, "deepreduce_tpu/tracking.py") == []
+    src = "from jax.experimental.shard_map import shard_map\n"
+    assert lint_source(src, "deepreduce_tpu/utils/compat.py") == []
+
+
+# ---------------------------------------------------------------------- #
+# jaxpr negative fixtures — each rule fires, alone, with its own id
+# ---------------------------------------------------------------------- #
+
+
+def test_f64_mini_codec_caught():
+    """A deliberately-f64 'codec': accumulate in double, cast back."""
+    from jax.experimental import enable_x64
+
+    def bad_encode(x):
+        return (x.astype(jnp.float64) * 2.0).astype(jnp.float32)
+
+    with enable_x64():
+        closed = jax.make_jaxpr(bad_encode)(jax.ShapeDtypeStruct((64,), jnp.float32))
+    v = _only(run_rules(closed, AuditContext(label="fixture:f64")), rules.R_F64)
+    assert "float64" in v.detail
+
+
+def test_unsorted_budget_gather_caught():
+    """Sorted indices whose gather doesn't carry the promise."""
+    k = 64
+
+    def bad_read(flat, idxs):
+        idxs = jnp.sort(idxs)
+        return flat[idxs]  # budget-scale gather, indices_are_sorted lost
+
+    closed = jax.make_jaxpr(bad_read)(
+        jax.ShapeDtypeStruct((1024,), jnp.float32),
+        jax.ShapeDtypeStruct((k,), jnp.int32),
+    )
+    ctx = AuditContext(label="fixture:unsorted", budget_scale=k)
+    _only(run_rules(closed, ctx), rules.R_UNSORTED_BUDGET_GATHER)
+
+
+def test_two_collective_fused_exchange_caught():
+    """A 'fused' exchange that issues two all_gathers breaks the
+    one-collective-per-step contract."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = audit_mesh()
+
+    def spmd(x):
+        a = jax.lax.all_gather(x[0], AXIS)
+        b = jax.lax.all_gather(x[0] * 2.0, AXIS)
+        return (a + b).sum(axis=0)[None]
+
+    fn = shard_map(spmd, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, 128), jnp.float32))
+    ctx = AuditContext(
+        label="fixture:two-collectives", expect_collectives={"all_gather": 1}
+    )
+    v = _only(run_rules(closed, ctx), rules.R_COLLECTIVE_COUNT)
+    assert "all_gather" in v.detail
+
+
+def test_gather_in_mod_query_caught():
+    def bad_query(words, idxs):
+        return words[idxs]  # a gather in what must be a broadcast path
+
+    closed = jax.make_jaxpr(bad_query)(
+        jax.ShapeDtypeStruct((256,), jnp.uint32),
+        jax.ShapeDtypeStruct((16,), jnp.int32),
+    )
+    ctx = AuditContext(label="fixture:mod-gather", forbid_gather=True)
+    _only(run_rules(closed, ctx), rules.R_GATHER_IN_MOD_QUERY)
+
+
+def test_unwhitelisted_callback_caught():
+    def bad(x):
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x
+        )
+
+    closed = jax.make_jaxpr(bad)(jax.ShapeDtypeStruct((32,), jnp.float32))
+    _only(
+        run_rules(closed, AuditContext(label="fixture:callback")),
+        rules.R_CALLBACK,
+    )
+    # the same trace is fine for a whitelisted host codec
+    ok = run_rules(closed, AuditContext(label="fixture:host", allow_callbacks=True))
+    assert ok == []
+
+
+def test_wire_accounting_mismatch_caught():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = audit_mesh()
+    d = 128
+
+    def spmd(x):
+        return jax.lax.all_gather(x[0], AXIS).sum(axis=0)[None]
+
+    fn = shard_map(spmd, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, d), jnp.float32))
+    good = AuditContext(label="fixture:wire-ok", wire_mode="allgather",
+                        expected_wire_bytes=4 * d)
+    assert run_rules(closed, good) == []
+    bad = AuditContext(label="fixture:wire-bad", wire_mode="allgather",
+                       expected_wire_bytes=4 * d + 1)
+    _only(run_rules(closed, bad), rules.R_WIRE_ACCOUNTING)
+
+
+def test_retrace_hash_stable():
+    """Two traces of the same codec program hash identically — the guard
+    that trips means every step would recompile."""
+    rec = trace_and_check(
+        "retrace-probe",
+        lambda x: x * 2.0,
+        (jax.ShapeDtypeStruct((64,), jnp.float32),),
+        AuditContext(label="retrace-probe"),
+    )
+    assert rec.violations == []
+    assert len(rec.jaxpr_hash) == 16
+
+
+# ---------------------------------------------------------------------- #
+# CLI gate
+# ---------------------------------------------------------------------- #
+
+
+def test_cli_exit_codes(monkeypatch, tmp_path):
+    """`python -m deepreduce_tpu.analysis` exits 0 clean, 1 on violations."""
+    from deepreduce_tpu.analysis import __main__ as cli
+    from deepreduce_tpu.analysis import ast_lint as al
+    from deepreduce_tpu.analysis import jaxpr_audit as ja
+
+    monkeypatch.setattr(ja, "audit_all", lambda quick=False: ([], []))
+    monkeypatch.setattr(al, "lint_repo", lambda root=None: [])
+    out = tmp_path / "report.json"
+    assert cli.main(["--quick", "--out", str(out)]) == 0
+    assert out.exists()
+
+    bad = rules.Violation("ast-compat-route", "x.py:1", "fixture")
+    monkeypatch.setattr(al, "lint_repo", lambda root=None: [bad])
+    assert cli.main(["--quick", "--out", "-"]) == 1
+
+
+# ---------------------------------------------------------------------- #
+# config satellites
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize(
+    "field,value",
+    [
+        ("compressor", "topkk"),
+        ("communicator", "allgater"),
+        ("memory", "residuals"),
+        ("deepreduce", "indices"),
+        ("policy", "left"),
+        ("index", "bloomfilter"),
+        ("value", "polyfit2"),
+        ("bloom_blocked", "modulo"),
+    ],
+)
+def test_config_rejects_typos(field, value):
+    with pytest.raises(ValueError, match=field):
+        DeepReduceConfig(**{field: value})
+
+
+def test_config_enums_match_registry():
+    """The documented enumerations stay in lock-step with the codec
+    registry — adding a codec without teaching config (or vice versa) is a
+    test failure, not a latent KeyError."""
+    from deepreduce_tpu.codecs import registry
+
+    assert set(DeepReduceConfig.INDEX_CODECS) == set(registry.INDEX_CODECS)
+    assert set(DeepReduceConfig.VALUE_CODECS) == set(registry.VALUE_CODECS)
+
+
+def test_from_params_strict():
+    params = {"compressor": "topk", "compress_ratio": 0.05}
+    assert from_params(params, strict=True).compress_ratio == 0.05
+    bad = {"compres_ratio": 0.05, "deepreduce": "index"}
+    assert from_params(bad).compress_ratio == 0.01  # lenient: silently dropped
+    with pytest.raises(ValueError, match="compres_ratio"):
+        from_params(bad, strict=True)
+    # reference-spelled aliases still map in strict mode
+    cfg = from_params({"threshold": 0.5, "micro-benchmark": True}, strict=True)
+    assert cfg.threshold_val == 0.5 and cfg.micro_benchmark

@@ -1,10 +1,28 @@
-.PHONY: analyze analyze-quick test test-quick
+.PHONY: analyze analyze-quick test test-quick telemetry-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
-# violation. CPU-only, trace-only (no compiles).
-analyze:
+# violation. CPU-only, trace-only (no compiles). Also exercises the
+# telemetry round trip (telemetry-check) so the observability path can't
+# rot while the gate stays green.
+analyze: telemetry-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
+
+# end-to-end telemetry round trip on the CPU virtual mesh: a short
+# telemetry-on training run writes a tracked run dir (metrics + device
+# accumulators + Chrome trace), then the CLI digests it and re-emits the
+# merged trace — failure anywhere exits nonzero.
+TELEMETRY_CHECK_DIR := /tmp/drtpu_telemetry_check
+telemetry-check:
+	rm -rf $(TELEMETRY_CHECK_DIR)
+	JAX_PLATFORMS=cpu python benchmarks/train.py --platform cpu \
+		--model resnet20 --num_steps 4 --batch_size 8 --num_workers 4 \
+		--telemetry --track_dir $(TELEMETRY_CHECK_DIR) --run_name check \
+		--log_every 0 \
+		--grace_config "{'compressor':'topk','compress_ratio':0.05,'deepreduce':'index','index':'bloom','fpr':0.01,'memory':'residual'}"
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry summary $(TELEMETRY_CHECK_DIR)/check
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry trace \
+		$(TELEMETRY_CHECK_DIR)/check --out $(TELEMETRY_CHECK_DIR)/merged_trace.json
 
 # the tier-1 subset (flagship codec/query + the three fused decode
 # strategies) — what tests/test_analysis.py also runs

@@ -53,6 +53,36 @@ def _progress(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
+def _span(name: str):
+    """Lazy span handle — bench defers jax-touching imports until the
+    platform is pinned, so the telemetry import happens per call (cheap:
+    module lookup after the first)."""
+    from deepreduce_tpu.telemetry import spans
+
+    return spans.span(name)
+
+
+def _trace_out_path():
+    """`--trace-out PATH`: save a Chrome trace of the bench phases there.
+    Raw-sys.argv style like --quick/--decode-sweep, and forwarded verbatim
+    to the TPU child process (which is the one that records and writes)."""
+    if "--trace-out" in sys.argv:
+        i = sys.argv.index("--trace-out")
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return None
+
+
+def _maybe_save_trace() -> None:
+    path = _trace_out_path()
+    if path is None:
+        return
+    from deepreduce_tpu.telemetry import spans
+
+    spans.get_tracer().save(path)
+    _progress(f"telemetry trace -> {path}")
+
+
 def _sync(x):
     import jax
 
@@ -110,13 +140,16 @@ def measure_config(d, ratio, cfg_kwargs, iters):
     key = jax.random.PRNGKey(0)
     encode = jax.jit(lambda t, s: codec.encode(t, step=s, key=key))
     decode = jax.jit(lambda p, s: codec.decode(p, step=s))
-    _progress(f"d={d} {cfg_kwargs.get('index') or 'topr'}: compiling encode")
-    payload = _sync(encode(g, 0))
-    _progress(f"d={d}: compiling decode")
-    _sync(decode(payload, 0))
+    label = cfg_kwargs.get("index") or "topr"
+    _progress(f"d={d} {label}: compiling encode")
+    with _span(f"bench/compile/d{d}/{label}"):
+        payload = _sync(encode(g, 0))
+        _progress(f"d={d}: compiling decode")
+        _sync(decode(payload, 0))
     _progress(f"d={d}: timing ({iters} iters, amortized)")
-    t_enc = _timeit(encode, g, 1, iters=iters)
-    t_dec = _timeit(decode, payload, 1, iters=iters)
+    with _span(f"bench/time/d{d}/{label}"):
+        t_enc = _timeit(encode, g, 1, iters=iters)
+        t_dec = _timeit(decode, payload, 1, iters=iters)
     _progress(f"d={d}: done enc={t_enc:.4f}s dec={t_dec:.4f}s")
     stats = codec.wire_stats(payload)
     return {
@@ -429,6 +462,10 @@ def decode_strategy_sweep(d: int = LSTM_D, workers: int = 8) -> dict:
 
 
 def main() -> None:
+    if _trace_out_path():
+        from deepreduce_tpu.telemetry import spans
+
+        spans.configure(enabled=True, reset=True)
     if "--decode-sweep" in sys.argv:
         # standalone sweep mode: CPU-mesh only, one JSON record on stdout
         from deepreduce_tpu.utils import force_platform
@@ -571,9 +608,10 @@ def main() -> None:
             bloom_threshold_insert=True,
         ),
     }
-    measured = {
-        name: measure_config(d, ratio, kw, iters) for name, kw in configs.items()
-    }
+    with _span("bench/codec-table"):
+        measured = {
+            name: measure_config(d, ratio, kw, iters) for name, kw in configs.items()
+        }
     dense = {"payload_bytes": 4.0 * d, "rel_volume": 1.0, "t_encode_s": 0.0, "t_decode_s": 0.0}
 
     t_dense = exchange_time(dense, BW_100MBPS)
@@ -627,7 +665,8 @@ def main() -> None:
                 fpr=0.001, memory="none",
             ),
         }.items():
-            r50 = measure_config(RESNET50_D, 0.01, rkw, 3)
+            with _span(f"bench/{rname}"):
+                r50 = measure_config(RESNET50_D, 0.01, rkw, 3)
             detail[rname] = {
                 "rel_volume": round(r50["rel_volume"], 5),
                 "t_encode_s": round(r50["t_encode_s"], 4),
@@ -645,12 +684,14 @@ def main() -> None:
     if not quick:
         # OBSERVED exchange throughput next to the analytic model above
         try:
-            detail["measured_exchange"] = _measured_exchange(degraded)
+            with _span("bench/measured-exchange"):
+                detail["measured_exchange"] = _measured_exchange(degraded)
         except Exception as e:  # noqa: BLE001 — headline must still print
             _progress(f"measured exchange failed: {e}")
         # loop-vs-vmap-vs-ring fused-decode sweep on the CPU mesh
         try:
-            detail["decode_strategy_sweep"] = decode_strategy_sweep()
+            with _span("bench/decode-sweep"):
+                detail["decode_strategy_sweep"] = decode_strategy_sweep()
         except Exception as e:  # noqa: BLE001
             _progress(f"decode strategy sweep failed: {e}")
 
@@ -661,7 +702,8 @@ def main() -> None:
         # metric): full fwd+bwd+compressed-exchange steps on the real chip.
         # The persistent compile cache makes repeat runs fast.
         try:
-            models = _model_throughput()
+            with _span("bench/model-throughput"):
+                models = _model_throughput()
             detail["model_throughput"] = models
             r50 = models.get("resnet50", {}).get("topk1_bloom", {})
             if r50:
@@ -671,6 +713,7 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _progress(f"model throughput failed: {e}")
 
+    _maybe_save_trace()
     print(
         json.dumps(
             {

@@ -165,6 +165,14 @@ def run(args) -> dict:
     # CLI-entered dicts get the strict treatment: a typo'd knob should kill
     # the run, not silently bench the default
     cfg = from_params(params, strict=True)
+    if args.telemetry and not cfg.telemetry:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, telemetry=True)
+    from deepreduce_tpu.telemetry import spans
+
+    if cfg.telemetry:
+        spans.configure(enabled=True)
     model, (kind, spec, classes) = MODELS[args.model]()
 
     n_dev = min(args.num_workers, len(jax.devices()))
@@ -211,13 +219,23 @@ def run(args) -> dict:
                 jax.profiler.start_trace(profile_dir)  # skip compile steps
                 profiling = True
             batch = make_batch(kind, spec, classes, args.batch_size, rng, model=model)
-            state, loss, wire = trainer.step(state, batch, jax.random.fold_in(key, step))
+            with spans.span("train/step"):
+                state, loss, wire = trainer.step(
+                    state, batch, jax.random.fold_in(key, step)
+                )
             losses.append(float(loss))
             if tracker is not None:
-                tracker.log(
-                    {"loss": losses[-1], "rel_volume": float(wire.rel_volume())},
-                    step=step,
-                )
+                rec = {"loss": losses[-1], "rel_volume": float(wire.rel_volume())}
+                if cfg.telemetry and (
+                    step % cfg.telemetry_every == 0 or step == args.num_steps - 1
+                ):
+                    # the telemetry_every host sync: fetch the on-device
+                    # accumulators and log them under a stable prefix
+                    rec.update(
+                        {f"telemetry.{k}": v
+                         for k, v in trainer.telemetry_summary().items()}
+                    )
+                tracker.log(rec, step=step)
             if args.log_every and step % args.log_every == 0:
                 print(
                     f"step {step} loss {losses[-1]:.4f} "
@@ -227,6 +245,10 @@ def run(args) -> dict:
         if profiling:
             jax.profiler.stop_trace()
         if tracker is not None:
+            if cfg.telemetry:
+                # a failing run still gets its trace — spans record in
+                # finally, so the aborted step's phases are all present
+                spans.get_tracer().save(tracker.dir / "trace.json")
             tracker.finish({"status": "failed", "steps_completed": len(losses)})
         raise
     if profiling:
@@ -247,6 +269,10 @@ def run(args) -> dict:
         "payload_bytes_per_step": trainer.exchanger.payload_bytes(state.params),
         "config": params,
     }
+    if cfg.telemetry:
+        result["telemetry"] = trainer.telemetry_summary()
+        if tracker is not None:
+            spans.get_tracer().save(tracker.dir / "trace.json")
     print(json.dumps(result))
     if tracker is not None:
         tracker.finish(result)
@@ -268,6 +294,12 @@ def main():
     ap.add_argument("--run_name", type=str, default="")
     ap.add_argument("--tags", type=str, default="",
                     help="comma-separated run tags (--extra_wandb_tags role)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="enable the telemetry subsystem (deepreduce_tpu."
+                         "telemetry): span tracing (trace.json in the run "
+                         "dir when --track_dir is set) plus on-device "
+                         "metric accumulators fetched every "
+                         "cfg.telemetry_every steps")
     ap.add_argument("--profile_dir", type=str, default="",
                     help="write a jax.profiler trace of the steady-state steps "
                          "(the reference's --log_time timing role, but a real "

@@ -29,6 +29,7 @@ from deepreduce_tpu import (
     parallel,
     qar,
     sparse,
+    telemetry,
     tracking,
 )
 from deepreduce_tpu.config import DeepReduceConfig, from_params
@@ -52,5 +53,6 @@ __all__ = [
     "parallel",
     "qar",
     "sparse",
+    "telemetry",
     "tracking",
 ]

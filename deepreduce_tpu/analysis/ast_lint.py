@@ -1,6 +1,6 @@
 """Repo-specific AST lint — the source-level half of the analysis gate.
 
-Three rules, each pinned to the scope where the hazard is real:
+Four rules, each pinned to the scope where the hazard is real:
 
 - ``ast-compat-route`` (repo-wide): `shard_map` / `pcast` must be imported
   from `deepreduce_tpu.utils.compat`, never from `jax.experimental.*`
@@ -15,6 +15,12 @@ Three rules, each pinned to the scope where the hazard is real:
   is a `jnp.*`/`jax.lax.*`/`jax.numpy.*` call. Under trace that raises a
   TracerBoolConversionError at best; at worst (concrete sub-values) it
   bakes a data-dependent branch into what must be a static program.
+- ``ast-span-outside-host`` (codecs/): no `telemetry.span`/`spans.span`
+  and no `DumpLogger` construction inside codec modules. Spans are
+  host-side context managers (wall clock + profiler annotation); a codec
+  body is traced once and replayed, so a span there measures trace time
+  and then silently never fires again — instrument the communicator and
+  driver layers instead (comm.py, train.py, bench drivers).
 
 Pure stdlib `ast`; no jax import, so this pass runs anywhere in
 milliseconds.
@@ -31,6 +37,7 @@ from deepreduce_tpu.analysis.rules import Violation
 R_AST_COMPAT = "ast-compat-route"
 R_AST_ENTROPY = "ast-host-entropy"
 R_AST_BRANCH = "ast-traced-branch"
+R_AST_SPAN = "ast-span-outside-host"
 
 # the one module allowed to touch jax.experimental.shard_map directly
 COMPAT_MODULE = "deepreduce_tpu/utils/compat.py"
@@ -54,6 +61,12 @@ CODEC_MODULES = (
     "deepreduce_tpu/sparse.py",
     "deepreduce_tpu/wrappers.py",
 )
+
+# modules where host-side telemetry (spans, dump loggers) is banned: codec
+# bodies are traced once and replayed — a span there is a silent lie
+SPAN_BANNED_MODULES = ("deepreduce_tpu/codecs/",)
+
+_SPAN_HEADS = ("telemetry", "spans")
 
 _ENTROPY_CHAINS = (
     ("time", "time"),
@@ -167,6 +180,31 @@ def _traced_branch_violations(tree: ast.AST, relpath: str) -> List[Violation]:
     return out
 
 
+def _span_violations(tree: ast.AST, relpath: str) -> List[Violation]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain:
+            continue
+        span_call = chain[-1] == "span" and (
+            len(chain) == 1 or chain[0] in _SPAN_HEADS
+        )
+        if span_call or "DumpLogger" in chain:
+            out.append(
+                Violation(
+                    R_AST_SPAN,
+                    f"{relpath}:{node.lineno}",
+                    f"host-side telemetry `{'.'.join(chain)}(...)` inside a "
+                    "codec module — codec bodies are traced (a span here "
+                    "fires once at trace time, then never again); "
+                    "instrument the communicator/driver layer instead",
+                )
+            )
+    return out
+
+
 def lint_source(src: str, relpath: str) -> List[Violation]:
     """Lint one module's source. `relpath` is repo-relative with forward
     slashes; it selects which rule scopes apply."""
@@ -178,6 +216,8 @@ def lint_source(src: str, relpath: str) -> List[Violation]:
         out.extend(_entropy_violations(tree, relpath))
     if _in_scope(relpath, CODEC_MODULES):
         out.extend(_traced_branch_violations(tree, relpath))
+    if _in_scope(relpath, SPAN_BANNED_MODULES):
+        out.extend(_span_violations(tree, relpath))
     return out
 
 

@@ -163,6 +163,23 @@ class BloomCodec(Codec):
     def value_wire_bits(self, payload):
         return payload.nsel.astype(jnp.float32) * 32
 
+    def fp_stats(self, payload):
+        """Measured false-positive inputs for telemetry: (filter positives
+        beyond the live selected count, not-selected universe size). The
+        filter has no false negatives, so positives − nsel IS the FP count
+        (threshold-insert overflow also lands here — either way it is
+        reconstruction the receiver sees that the sender never ranked)."""
+        positives = (
+            bloom.query_universe(payload.words, self.meta)
+            .sum()
+            .astype(jnp.float32)
+        )
+        nsel = payload.nsel.astype(jnp.float32)
+        return (
+            jnp.maximum(positives - nsel, 0.0),
+            jnp.maximum(jnp.asarray(float(self.d), jnp.float32) - nsel, 0.0),
+        )
+
 
 class RLECodec(Codec):
     kind = "index"

@@ -42,6 +42,7 @@ from deepreduce_tpu.metrics import (
     ring_wire_bytes,
 )
 from deepreduce_tpu.sparse import per_tensor_key
+from deepreduce_tpu.telemetry import spans
 from deepreduce_tpu.wrappers import TensorCodec
 
 
@@ -200,11 +201,24 @@ class GradientExchanger:
         *,
         step: jax.Array = 0,
         key: Optional[jax.Array] = None,
+        collect: Optional[Dict[str, jax.Array]] = None,
     ) -> Tuple[Any, Any, WireStats]:
         """Inside shard_map over `axis_name`: returns (aggregated dense
-        grads, new residual state, combined wire stats)."""
+        grads, new residual state, combined wire stats).
+
+        `collect`, when given a dict, receives worker-local traced
+        telemetry scalars the caller psums: ``fp_count`` (index-filter
+        positives beyond the payload's in-band selected count — bloom
+        false positives, measured by the codec's own `fp_stats` query) and
+        ``fp_universe`` (the not-selected universe, the FPR denominator).
+        Adds a d-scale filter query per bloom tensor, so only pass it when
+        `cfg.telemetry` is enabled."""
         cfg = self.cfg
         num_workers = jax.lax.psum(1, self.axis_name)
+        if collect is not None:
+            zero = jnp.zeros((), jnp.float32)
+            collect.setdefault("fp_count", zero)
+            collect.setdefault("fp_universe", zero)
 
         if cfg.communicator == "qar":
             return self._exchange_qar(grads, state, step=step, key=key)
@@ -242,20 +256,39 @@ class GradientExchanger:
 
         payloads = {}
         stats_per = {}
-        for name in self.names:
-            payloads[name] = self.codecs[name].encode(
-                flat_grads[name], step=step, key=keys[name]
-            )
-            stats_per[name] = self.codecs[name].wire_stats(payloads[name])
+        with spans.span("exchange/encode"):
+            for name in self.names:
+                payloads[name] = self.codecs[name].encode(
+                    flat_grads[name], step=step, key=keys[name]
+                )
+                stats_per[name] = self.codecs[name].wire_stats(payloads[name])
 
+        need_own = state is not None
         if self._layouts is not None:
             agg_leaves, own_leaves = self._exchange_fused(
-                payloads, num_workers, step, need_own=state is not None
+                payloads, num_workers, step, need_own=need_own
             )
         else:
             agg_leaves, own_leaves = self._exchange_per_tensor(
-                payloads, num_workers, step, need_own=state is not None
+                payloads, num_workers, step, need_own=need_own
             )
+
+        if collect is not None:
+            # measured bloom FPR inputs: the codec queries its own payload's
+            # filter (codecs expose fp_stats; exact index codecs return
+            # None). NOT derivable from the decoded tensor — the decoder
+            # places at most nsel values, so its nonzero count never
+            # exceeds nsel regardless of how many false positives fired
+            fp_c = jnp.zeros((), jnp.float32)
+            fp_u = jnp.zeros((), jnp.float32)
+            for name in self.names:
+                stats = self.codecs[name].fp_stats(payloads[name])
+                if stats is None:
+                    continue
+                fp_c = fp_c + stats[0]
+                fp_u = fp_u + stats[1]
+            collect["fp_count"] = fp_c
+            collect["fp_universe"] = fp_u
 
         # both paths aggregate/decode in f32; hand leaves back in the runtime
         # gradient dtype so residual state and optimizer updates keep their
@@ -338,7 +371,8 @@ class GradientExchanger:
           (comm_ring.ring_decode_exchange).
         """
         strategy = self.cfg.decode_strategy
-        buf = self._pack_fused(payloads)
+        with spans.span("exchange/pack"):
+            buf = self._pack_fused(payloads)
 
         if strategy == "ring":
             total, own_fin = comm_ring.ring_decode_exchange(
@@ -349,13 +383,17 @@ class GradientExchanger:
                 need_own=need_own,
             )
         else:
-            gathered = jax.lax.all_gather(buf, self.axis_name)  # [W, B]
+            with spans.span("exchange/allgather"):
+                gathered = jax.lax.all_gather(buf, self.axis_name)  # [W, B]
             decoder = (
                 self._decode_gathered_vmap
                 if strategy == "vmap"
                 else self._decode_gathered_loop
             )
-            total, own_fin = decoder(gathered, num_workers, step, need_own=need_own)
+            with spans.span("exchange/decode"):
+                total, own_fin = decoder(
+                    gathered, num_workers, step, need_own=need_own
+                )
 
         agg_leaves = {name: t / num_workers for name, t in zip(self.names, total)}
         own_leaves = dict(zip(self.names, own_fin)) if need_own else {}
@@ -438,15 +476,16 @@ class GradientExchanger:
         if state is not None:
             compensated = memory.compensate(grads, state, beta=cfg.beta, gamma=cfg.gamma)
         flat, unravel = ravel_pytree(compensated)
-        mean, own_flat, stats = sparse_rs.exchange(
-            flat.astype(jnp.float32),
-            self.axis_name,
-            self.num_workers,
-            ratio=cfg.compress_ratio,
-            approx_topk=cfg.approx_topk,
-            headroom=cfg.rs_headroom,
-            out_headroom=cfg.rs_out_headroom,
-        )
+        with spans.span("exchange/sparse_rs"):
+            mean, own_flat, stats = sparse_rs.exchange(
+                flat.astype(jnp.float32),
+                self.axis_name,
+                self.num_workers,
+                ratio=cfg.compress_ratio,
+                approx_topk=cfg.approx_topk,
+                headroom=cfg.rs_headroom,
+                out_headroom=cfg.rs_out_headroom,
+            )
         agg = unravel(mean.astype(flat.dtype))
         new_state = state
         if state is not None:
@@ -479,15 +518,16 @@ class GradientExchanger:
         if key is None:
             key = jax.random.PRNGKey(cfg.seed)
         key = jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
-        mean = qar.quantized_allreduce(
-            padded,
-            self.axis_name,
-            self.num_workers,
-            key=key,
-            quantum_num=cfg.quantum_num,
-            bucket_size=cfg.bucket_size,
-            use_pallas=cfg.use_pallas,
-        )[:d]
+        with spans.span("exchange/qar"):
+            mean = qar.quantized_allreduce(
+                padded,
+                self.axis_name,
+                self.num_workers,
+                key=key,
+                quantum_num=cfg.quantum_num,
+                bucket_size=cfg.bucket_size,
+                use_pallas=cfg.use_pallas,
+            )[:d]
         agg = unravel(mean.astype(flat.dtype))
         # one payload (int8 levels + f32 norms) per phase-equivalent dense
         # transmission: rel_volume = payload_bits / dense_bits, the same

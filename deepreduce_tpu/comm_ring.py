@@ -37,6 +37,8 @@ from typing import Callable, Tuple
 
 import jax
 
+from deepreduce_tpu.telemetry import spans
+
 
 def _tree_add(a, b):
     return tuple(x + y for x, y in zip(a, b))
@@ -62,27 +64,28 @@ def ring_decode_exchange(
     static permutation).
     """
     W = int(num_workers)
-    own = decode_row(buf)
-    if W == 1:
-        return own, (own if need_own else ())
+    with spans.span("exchange/ring"):
+        own = decode_row(buf)
+        if W == 1:
+            return own, (own if need_own else ())
 
-    perm = [(j, (j + 1) % W) for j in range(W)]
-    send = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        perm = [(j, (j + 1) % W) for j in range(W)]
+        send = lambda x: jax.lax.ppermute(x, axis_name, perm)
 
-    # prologue: hop 1 departs while the own payload decodes
-    nxt = send(buf)
-    acc = own
+        # prologue: hop 1 departs while the own payload decodes
+        nxt = send(buf)
+        acc = own
 
-    # rounds 1 .. W-2: issue hop i+1, then decode the chunk from round i.
-    # The permute is issued first so its transfer has no dependence on the
-    # decode program and can run concurrently with it.
-    def body(_i, carry):
-        acc, cur = carry
-        nxt = send(cur)
-        acc = _tree_add(acc, decode_row(cur))
-        return acc, nxt
+        # rounds 1 .. W-2: issue hop i+1, then decode the chunk from round
+        # i. The permute is issued first so its transfer has no dependence
+        # on the decode program and can run concurrently with it.
+        def body(_i, carry):
+            acc, cur = carry
+            nxt = send(cur)
+            acc = _tree_add(acc, decode_row(cur))
+            return acc, nxt
 
-    acc, last = jax.lax.fori_loop(0, W - 2, body, (acc, nxt))
-    # epilogue: the final chunk has nothing left to forward
-    acc = _tree_add(acc, decode_row(last))
+        acc, last = jax.lax.fori_loop(0, W - 2, body, (acc, nxt))
+        # epilogue: the final chunk has nothing left to forward
+        acc = _tree_add(acc, decode_row(last))
     return acc, (own if need_own else ())

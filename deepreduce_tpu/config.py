@@ -116,6 +116,15 @@ class DeepReduceConfig:
     layer_pattern: Optional[str] = None
     # observability
     micro_benchmark: bool = False
+    # telemetry subsystem (deepreduce_tpu.telemetry): thread the on-device
+    # MetricAccumulators pytree through the jitted step and enable span
+    # tracing in the drivers. Off by default — the telemetry-off step
+    # program is byte-identical to a build without telemetry (pinned by the
+    # retrace-hash test), so this knob is provably free when False.
+    telemetry: bool = False
+    # host fetch cadence for the accumulators (steps between device->host
+    # syncs of the ten-scalar pytree); the hot loop itself never syncs
+    telemetry_every: int = 10
 
     # the documented enumerations (comments above + codecs/registry.py).
     # __post_init__ checks against these so a typo like
@@ -156,6 +165,10 @@ class DeepReduceConfig:
             )
         if self.decode_batch < 1:
             raise ValueError(f"decode_batch must be >= 1, got {self.decode_batch}")
+        if self.telemetry_every < 1:
+            raise ValueError(
+                f"telemetry_every must be >= 1, got {self.telemetry_every}"
+            )
 
     @classmethod
     def tpu_defaults(cls, **overrides) -> "DeepReduceConfig":

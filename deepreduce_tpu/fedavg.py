@@ -42,6 +42,7 @@ import optax
 
 from deepreduce_tpu.config import DeepReduceConfig
 from deepreduce_tpu.metrics import WireStats, combine
+from deepreduce_tpu.telemetry import spans
 from deepreduce_tpu.wrappers import TensorCodec
 
 
@@ -183,9 +184,10 @@ class FedAvg:
         # loop is self-correcting: undelivered mass reappears in the next
         # round's delta (no explicit residual — see module docstring)
         delta = jax.tree_util.tree_map(lambda w, r: w - r, state.params, state.w_ref)
-        dec_delta, _, wire_s2c = self._compress_tree(
-            "s2c", delta, None, state.round, key_s2c
-        )
+        with spans.span("fedavg/s2c"):
+            dec_delta, _, wire_s2c = self._compress_tree(
+                "s2c", delta, None, state.round, key_s2c
+            )
         w_ref = jax.tree_util.tree_map(jnp.add, state.w_ref, dec_delta)
 
         # --- local training + C2S on each sampled client -----------------
@@ -214,13 +216,16 @@ class FedAvg:
             else:
                 c, batch_c = xs
                 res_c = None
-            p_end = self._local_train(
-                w_ref, batch_c, jax.random.fold_in(key_c2s, 2 * c)
-            )
+            with spans.span("fedavg/local_train"):
+                p_end = self._local_train(
+                    w_ref, batch_c, jax.random.fold_in(key_c2s, 2 * c)
+                )
             update = jax.tree_util.tree_map(lambda a, b: a - b, p_end, w_ref)
-            dec_upd, new_res_c, wire_c = self._compress_tree(
-                "c2s", update, res_c, state.round, jax.random.fold_in(key_c2s, 2 * c + 1)
-            )
+            with spans.span("fedavg/c2s"):
+                dec_upd, new_res_c, wire_c = self._compress_tree(
+                    "c2s", update, res_c, state.round,
+                    jax.random.fold_in(key_c2s, 2 * c + 1),
+                )
             upd_sum = jax.tree_util.tree_map(jnp.add, upd_sum, dec_upd)
             wire_acc = WireStats(
                 index_bits=wire_acc.index_bits + wire_c.index_bits,
@@ -231,9 +236,10 @@ class FedAvg:
 
         cs = jnp.arange(C, dtype=jnp.uint32)
         xs = (cs, client_batches, res_stack) if use_res else (cs, client_batches)
-        (upd_sum, wire_c2s), new_res_stack = jax.lax.scan(
-            client_body, (upd_sum0, wire0), xs
-        )
+        with spans.span("fedavg/clients"):
+            (upd_sum, wire_c2s), new_res_stack = jax.lax.scan(
+                client_body, (upd_sum0, wire0), xs
+            )
         if use_res:
             c2s_res = jax.tree_util.tree_map(
                 lambda buf, nr: buf.at[ids].set(nr), c2s_res, new_res_stack

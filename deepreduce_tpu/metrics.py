@@ -83,11 +83,19 @@ def payload_device_bytes(payload: Any) -> int:
 def timed(label: str, enabled: bool = True, sink: Dict[str, float] | None = None) -> Iterator[None]:
     """micro-benchmark timer (the reference's cuda-synchronized prints,
     pytorch/deepreduce.py:70-76). Call inside host code around
-    block_until_ready'd work."""
+    block_until_ready'd work.
+
+    Records in a ``finally`` so a raising body still reports its elapsed
+    time. A `sink` always receives the accumulated total; printing happens
+    only when `enabled` AND no sink is given — a sink means programmatic
+    consumption, not console spam (the two flags used to be tangled:
+    sink-only callers could not record silently)."""
     start = time.perf_counter()
-    yield
-    elapsed = time.perf_counter() - start
-    if sink is not None:
-        sink[label] = sink.get(label, 0.0) + elapsed
-    if enabled:
-        print(f"{label} time:{elapsed}")
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter() - start
+        if sink is not None:
+            sink[label] = sink.get(label, 0.0) + elapsed
+        elif enabled:
+            print(f"{label} time:{elapsed}")

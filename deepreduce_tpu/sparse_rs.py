@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from deepreduce_tpu import sparse
 from deepreduce_tpu.metrics import WireStats
+from deepreduce_tpu.telemetry import spans
 
 
 def shard_size(d: int, num_workers: int) -> int:
@@ -81,7 +82,8 @@ def exchange(
 
     # sort_indices=False keeps lax.top_k's descending-|v| order — the
     # overflow-drop-smallest property below depends on it
-    sp = sparse.topk(flat, ratio, sort_indices=False, approx=approx_topk)
+    with spans.span("sparse_rs/select"):
+        sp = sparse.topk(flat, ratio, sort_indices=False, approx=approx_topk)
     k = sp.k
 
     # --- phase 1: route entries to their shard-owners ------------------- #
@@ -120,14 +122,18 @@ def exchange(
         [send_v.astype(jnp.float32),
          jax.lax.bitcast_convert_type(send_i, jnp.float32)], axis=1
     )  # [W, 2B]
-    rx = jax.lax.all_to_all(send_buf, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    with spans.span("sparse_rs/route"):
+        rx = jax.lax.all_to_all(
+            send_buf, axis_name, split_axis=0, concat_axis=0, tiled=True
+        )
     rx_v = rx[:, :B]
     rx_i = jax.lax.bitcast_convert_type(rx[:, B:], jnp.int32)
 
     # --- reduce my shard ------------------------------------------------- #
-    shard_buf = jnp.zeros((S,), jnp.float32).at[rx_i.reshape(-1)].add(
-        rx_v.reshape(-1).astype(jnp.float32)
-    )
+    with spans.span("sparse_rs/reduce"):
+        shard_buf = jnp.zeros((S,), jnp.float32).at[rx_i.reshape(-1)].add(
+            rx_v.reshape(-1).astype(jnp.float32)
+        )
     # zero-value dead slots all land on local index 0: adding 0 is exact
 
     # --- phase 2: re-select the reduced shard and allgather -------------- #
@@ -140,7 +146,8 @@ def exchange(
         [out_vals.astype(jnp.float32),
          jax.lax.bitcast_convert_type(out_idx, jnp.float32)]
     )  # [2*K2]
-    gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
+    with spans.span("sparse_rs/allgather"):
+        gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
     gathered_v = gathered[:, :K2]
     gathered_i = jax.lax.bitcast_convert_type(gathered[:, K2:], jnp.int32)
     dense = (

@@ -1,0 +1,37 @@
+"""Telemetry subsystem: span tracing, on-device metric accumulators, and a
+run-comparison CLI.
+
+Three parts (ARCHITECTURE.md "Telemetry"):
+
+- `spans` — host-side hierarchical tracer; `span("exchange/encode")`
+  records Chrome-trace-event JSON (Perfetto-loadable) and forwards the
+  label to `jax.named_scope` / `jax.profiler.TraceAnnotation` so the same
+  names appear in XLA device profiles. Disabled (the default) it is a
+  shared inert no-op.
+- `device_metrics` — `MetricAccumulators`, a registered-dataclass pytree
+  of running counters threaded through the jitted step when
+  `cfg.telemetry=True`; fetched every `cfg.telemetry_every` steps.
+- `python -m deepreduce_tpu.telemetry {summary,compare,trace}` — the
+  offline consumer over `tracking.py` run directories (`__main__.py`).
+"""
+
+from deepreduce_tpu.telemetry import device_metrics, spans
+from deepreduce_tpu.telemetry.device_metrics import MetricAccumulators
+from deepreduce_tpu.telemetry.spans import (
+    Tracer,
+    configure,
+    enabled,
+    get_tracer,
+    span,
+)
+
+__all__ = [
+    "MetricAccumulators",
+    "Tracer",
+    "configure",
+    "device_metrics",
+    "enabled",
+    "get_tracer",
+    "span",
+    "spans",
+]

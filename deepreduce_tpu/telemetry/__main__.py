@@ -1,0 +1,315 @@
+"""`python -m deepreduce_tpu.telemetry {summary,compare,trace}` — the
+offline consumer of tracking.py run directories.
+
+- ``summary RUN``            one-screen digest of a run: loss trajectory,
+                             rel-volume and step-time distributions, and
+                             the device-accumulator fields from
+                             summary.json when the run had telemetry on.
+- ``compare RUN_A RUN_B``    diff two runs' step-time and rel-volume
+                             distributions; exits 1 when B's mean step
+                             time regresses past ``--tol`` vs A.
+- ``compare RUN --against BENCH_DECODE_r06.json``
+                             check a run against the committed decode-
+                             strategy bench record (matched on the run's
+                             `decode_strategy` config); exits 1 on a
+                             step-time regression — the bench trajectory's
+                             automated consumer.
+- ``trace RUN [--out F]``    merged Chrome trace: the run's span events
+                             (trace.json, written by benchmarks/train.py
+                             --telemetry) plus per-step metrics as "C"
+                             counter events. Load the output in Perfetto.
+
+RUN may be a run directory or a tracking root (latest run is picked).
+Exit codes: 0 ok, 1 flagged regression, 2 usage/data error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _fail(msg: str) -> int:
+    print(f"telemetry: error: {msg}", file=sys.stderr)
+    return 2
+
+
+def _resolve_run(path: str) -> Optional[pathlib.Path]:
+    """A run dir (has config.json) or a tracking root (latest run wins)."""
+    p = pathlib.Path(path)
+    if (p / "config.json").exists():
+        return p
+    if p.is_dir():
+        runs = sorted(
+            (d for d in p.iterdir() if (d / "config.json").exists()),
+            key=lambda d: d.stat().st_mtime,
+        )
+        if runs:
+            return runs[-1]
+    return None
+
+
+def _history(run: pathlib.Path) -> List[Dict[str, Any]]:
+    path = run / "metrics.jsonl"
+    if not path.exists():
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _load_json(path: pathlib.Path) -> Dict[str, Any]:
+    if not path.exists():
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def _series(hist: List[Dict[str, Any]], key: str) -> List[float]:
+    return [float(r[key]) for r in hist if isinstance(r.get(key), (int, float))]
+
+
+def _step_times(hist: List[Dict[str, Any]]) -> List[float]:
+    """Per-step wall time from consecutive metrics.jsonl timestamps. The
+    first interval (compile) is dropped when there are enough samples."""
+    ts = _series(hist, "ts")
+    dt = [b - a for a, b in zip(ts, ts[1:]) if b >= a]
+    return dt[1:] if len(dt) > 2 else dt
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return float("nan")
+    ys = sorted(xs)
+    i = min(len(ys) - 1, max(0, round(q * (len(ys) - 1))))
+    return ys[int(i)]
+
+
+def _dist(xs: List[float]) -> Dict[str, float]:
+    if not xs:
+        return {"n": 0}
+    return {
+        "n": len(xs),
+        "mean": sum(xs) / len(xs),
+        "p50": _percentile(xs, 0.5),
+        "p90": _percentile(xs, 0.9),
+        "min": min(xs),
+        "max": max(xs),
+    }
+
+
+def _fmt_dist(d: Dict[str, float], unit: str = "") -> str:
+    if not d.get("n"):
+        return "(no samples)"
+    return (
+        f"mean {d['mean']:.6g}{unit}  p50 {d['p50']:.6g}{unit}  "
+        f"p90 {d['p90']:.6g}{unit}  n={d['n']}"
+    )
+
+
+def _run_report(run: pathlib.Path) -> Dict[str, Any]:
+    cfg = _load_json(run / "config.json")
+    summ = _load_json(run / "summary.json")
+    hist = _history(run)
+    losses = _series(hist, "loss")
+    report = {
+        "run": run.name,
+        "dir": str(run),
+        "config": cfg.get("config", {}),
+        "steps_logged": len(hist),
+        "loss_first": losses[0] if losses else None,
+        "loss_last": losses[-1] if losses else None,
+        "rel_volume": _dist(_series(hist, "rel_volume")),
+        "step_time_s": _dist(_step_times(hist)),
+    }
+    telem = summ.get("telemetry")
+    if isinstance(telem, dict):
+        report["telemetry"] = telem
+    return report
+
+
+# ---------------------------------------------------------------------- #
+# summary
+# ---------------------------------------------------------------------- #
+
+
+def cmd_summary(args) -> int:
+    run = _resolve_run(args.run)
+    if run is None:
+        return _fail(f"no run directory under {args.run!r} (need config.json)")
+    rep = _run_report(run)
+    if args.json:
+        print(json.dumps(rep, indent=2))
+        return 0
+    cfg = rep["config"]
+    print(f"run {rep['run']}  ({rep['dir']})")
+    if cfg:
+        knobs = ", ".join(f"{k}={v}" for k, v in sorted(cfg.items()))
+        print(f"  config: {knobs}")
+    print(f"  steps logged: {rep['steps_logged']}")
+    if rep["loss_first"] is not None:
+        print(f"  loss: {rep['loss_first']:.4f} -> {rep['loss_last']:.4f}")
+    print(f"  rel_volume: {_fmt_dist(rep['rel_volume'])}")
+    print(f"  step_time:  {_fmt_dist(rep['step_time_s'], 's')}")
+    if "telemetry" in rep:
+        print("  device accumulators:")
+        for k, v in sorted(rep["telemetry"].items()):
+            print(f"    {k}: {v:.6g}" if isinstance(v, float) else f"    {k}: {v}")
+    return 0
+
+
+# ---------------------------------------------------------------------- #
+# compare
+# ---------------------------------------------------------------------- #
+
+
+def _bench_step_time(bench: Dict[str, Any], strategy: str) -> Optional[float]:
+    strategies = bench.get("detail", {}).get("strategies", {})
+    rec = strategies.get(strategy)
+    if isinstance(rec, dict) and isinstance(rec.get("t_step_s"), (int, float)):
+        return float(rec["t_step_s"])
+    return None
+
+
+def cmd_compare(args) -> int:
+    run_a = _resolve_run(args.run_a)
+    if run_a is None:
+        return _fail(f"no run directory under {args.run_a!r}")
+    rep_a = _run_report(run_a)
+    t_a = rep_a["step_time_s"].get("mean")
+
+    if args.against:
+        bench = _load_json(pathlib.Path(args.against))
+        if not bench:
+            return _fail(f"cannot read bench record {args.against!r}")
+        strategy = str(rep_a["config"].get("decode_strategy", "loop"))
+        t_bench = _bench_step_time(bench, strategy)
+        if t_bench is None:
+            return _fail(
+                f"{args.against!r} has no detail.strategies[{strategy!r}]"
+                ".t_step_s entry"
+            )
+        if t_a is None:
+            return _fail(f"run {rep_a['run']} has no step-time samples")
+        ratio = t_a / t_bench
+        regressed = t_a > t_bench * (1.0 + args.tol)
+        flag = "REGRESSION" if regressed else "ok"
+        print(
+            f"{rep_a['run']} [{strategy}]: step_time mean {t_a:.6g}s vs bench "
+            f"{t_bench:.6g}s  ({ratio:.2f}x, tol {args.tol:.0%})  {flag}"
+        )
+        return 1 if regressed else 0
+
+    if not args.run_b:
+        return _fail("compare needs RUN_B or --against BENCH.json")
+    run_b = _resolve_run(args.run_b)
+    if run_b is None:
+        return _fail(f"no run directory under {args.run_b!r}")
+    rep_b = _run_report(run_b)
+    t_b = rep_b["step_time_s"].get("mean")
+    print(f"A: {rep_a['run']}   B: {rep_b['run']}")
+    print(f"  step_time A: {_fmt_dist(rep_a['step_time_s'], 's')}")
+    print(f"  step_time B: {_fmt_dist(rep_b['step_time_s'], 's')}")
+    print(f"  rel_volume A: {_fmt_dist(rep_a['rel_volume'])}")
+    print(f"  rel_volume B: {_fmt_dist(rep_b['rel_volume'])}")
+    rv_a = rep_a["rel_volume"].get("mean")
+    rv_b = rep_b["rel_volume"].get("mean")
+    if rv_a and rv_b:
+        print(f"  rel_volume B/A: {rv_b / rv_a:.3f}x")
+    if t_a and t_b:
+        print(f"  step_time  B/A: {t_b / t_a:.3f}x")
+        if t_b > t_a * (1.0 + args.tol):
+            print(f"  REGRESSION: B exceeds A by more than {args.tol:.0%}")
+            return 1
+    return 0
+
+
+# ---------------------------------------------------------------------- #
+# trace
+# ---------------------------------------------------------------------- #
+
+
+def cmd_trace(args) -> int:
+    run = _resolve_run(args.run)
+    if run is None:
+        return _fail(f"no run directory under {args.run!r}")
+    trace = _load_json(run / "trace.json")
+    events = list(trace.get("traceEvents", []))
+    hist = _history(run)
+    # per-step metrics become counter tracks next to the span rows; their
+    # wall clock is rebased so step 0 aligns with the trace origin
+    ts0 = next((r["ts"] for r in hist if "ts" in r), None)
+    for rec in hist:
+        if "ts" not in rec:
+            continue
+        for key, val in rec.items():
+            if key in ("step", "ts") or not isinstance(val, (int, float)):
+                continue
+            events.append(
+                {
+                    "name": key,
+                    "ph": "C",
+                    "ts": round((rec["ts"] - ts0) * 1e6, 3),
+                    "pid": 1,
+                    "tid": 0,
+                    "args": {key: float(val)},
+                }
+            )
+    if not events:
+        return _fail(f"run {run.name} has neither trace.json events nor metrics")
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    merged = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if args.out and args.out != "-":
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        print(f"wrote {len(events)} events -> {args.out}")
+    else:
+        json.dump(merged, sys.stdout)
+        print()
+    return 0
+
+
+# ---------------------------------------------------------------------- #
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deepreduce_tpu.telemetry",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("summary", help="digest one run")
+    p.add_argument("run", help="run dir or tracking root (latest run)")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("compare", help="diff two runs, or a run vs a bench record")
+    p.add_argument("run_a")
+    p.add_argument("run_b", nargs="?", default="")
+    p.add_argument("--against", default="", metavar="BENCH.json",
+                   help="committed bench record (e.g. BENCH_DECODE_r06.json); "
+                        "matched on the run's decode_strategy")
+    p.add_argument("--tol", type=float, default=0.10,
+                   help="step-time regression tolerance (default 10%%)")
+    p.set_defaults(fn=cmd_compare)
+
+    p = sub.add_parser("trace", help="merged Chrome trace JSON (Perfetto)")
+    p.add_argument("run")
+    p.add_argument("--out", default="-", help="output path ('-' = stdout)")
+    p.set_defaults(fn=cmd_trace)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

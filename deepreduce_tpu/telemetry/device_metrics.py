@@ -1,0 +1,110 @@
+"""On-device running metric accumulators — the zero-sync half of telemetry.
+
+A registered-dataclass pytree of f32 scalar counters that rides through
+the jitted train step as an extra carry: every step adds its wire bits,
+saturation count, residual L2, compression error (L2 and cosine vs. the
+dense mean gradient) and measured bloom false positives *on device*; the
+host fetches the whole pytree every `cfg.telemetry_every` steps (one
+device-to-host transfer of ten scalars), so the hot loop itself gains zero
+host syncs. When `cfg.telemetry=False` the accumulator is never
+constructed and the step program is byte-identical to a build without
+telemetry (tests/test_telemetry.py pins this with the analysis retrace
+hash).
+
+All counters are f32 sums, so cumulative ratios are exact aggregates of
+the per-step quantities: ``rel_volume() == Σ(index+value bits)/Σ(dense
+bits)`` equals the mean of per-step `WireStats.rel_volume()` whenever
+dense_bits is step-constant (it is — shapes are static)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepreduce_tpu.metrics import WireStats
+
+_EPS = 1e-12
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MetricAccumulators:
+    """Running f32 scalar counters, one pytree, threaded through jit."""
+
+    steps: jax.Array
+    index_bits: jax.Array
+    value_bits: jax.Array
+    dense_bits: jax.Array
+    saturated: jax.Array      # total saturated tensor payloads (count)
+    residual_l2: jax.Array    # Σ per-step mean-over-workers ‖residual‖₂
+    err_l2: jax.Array         # Σ per-step ‖agg − dense_mean‖₂/‖dense_mean‖₂
+    err_cos: jax.Array        # Σ per-step cos(agg, dense_mean)
+    fp_count: jax.Array       # Σ bloom false positives (decoded-but-not-selected)
+    fp_universe: jax.Array    # Σ not-selected universe size (FPR denominator)
+
+    @classmethod
+    def zeros(cls) -> "MetricAccumulators":
+        z = jnp.zeros((), jnp.float32)
+        return cls(*(z,) * len(dataclasses.fields(cls)))
+
+    def accumulate(
+        self,
+        wire: WireStats,
+        *,
+        residual_l2=0.0,
+        err_l2=0.0,
+        err_cos=0.0,
+        fp_count=0.0,
+        fp_universe=0.0,
+    ) -> "MetricAccumulators":
+        f = lambda x: jnp.asarray(x, jnp.float32)
+        return MetricAccumulators(
+            steps=self.steps + 1.0,
+            index_bits=self.index_bits + f(wire.index_bits),
+            value_bits=self.value_bits + f(wire.value_bits),
+            dense_bits=self.dense_bits + f(wire.dense_bits),
+            saturated=self.saturated + f(wire.saturated),
+            residual_l2=self.residual_l2 + f(residual_l2),
+            err_l2=self.err_l2 + f(err_l2),
+            err_cos=self.err_cos + f(err_cos),
+            fp_count=self.fp_count + f(fp_count),
+            fp_universe=self.fp_universe + f(fp_universe),
+        )
+
+    # ------------------------------------------------------------------ #
+    # derived ratios (usable traced or on fetched values)
+    # ------------------------------------------------------------------ #
+
+    def rel_volume(self) -> jax.Array:
+        return (self.index_bits + self.value_bits) / jnp.maximum(self.dense_bits, _EPS)
+
+    def measured_fpr(self) -> jax.Array:
+        """Observed bloom FPR: false positives / not-inserted universe,
+        cumulatively — the empirical check of the configured `fpr`."""
+        return self.fp_count / jnp.maximum(self.fp_universe, 1.0)
+
+    def summary(self) -> Dict[str, float]:
+        """Fetch to host and reduce to plain floats (the telemetry_every
+        sync point; also what the CLI prints)."""
+        vals = {
+            f.name: float(np.asarray(getattr(self, f.name)))
+            for f in dataclasses.fields(self)
+        }
+        steps = max(vals["steps"], 1.0)
+        dense = max(vals["dense_bits"], _EPS)
+        return {
+            "steps": vals["steps"],
+            "cumulative_total_bits": vals["index_bits"] + vals["value_bits"],
+            "rel_volume": (vals["index_bits"] + vals["value_bits"]) / dense,
+            "idx_rel_volume": vals["index_bits"] / dense,
+            "val_rel_volume": vals["value_bits"] / dense,
+            "saturated_per_step": vals["saturated"] / steps,
+            "residual_l2_per_step": vals["residual_l2"] / steps,
+            "compress_err_l2": vals["err_l2"] / steps,
+            "compress_err_cos": vals["err_cos"] / steps,
+            "measured_fpr": vals["fp_count"] / max(vals["fp_universe"], 1.0),
+        }

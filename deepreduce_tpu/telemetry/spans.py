@@ -1,0 +1,189 @@
+"""Host-side hierarchical span tracing — the phase-timing half of telemetry.
+
+The reference observes phase cost with cuda-synchronized wall-clock prints
+(pytorch/deepreduce.py:70-76); papers like EQuARX and PacTrain make their
+case from fine-grained phase traces instead. This module is that
+capability: a `span("exchange/encode")` context manager that
+
+- records a Chrome-trace-event "X" (complete) event — the accumulated
+  trace is a ``{"traceEvents": [...]}`` JSON loadable in Perfetto or
+  chrome://tracing;
+- enters `jax.named_scope(name)`, so spans opened around traced code label
+  the generated HLO and the same names appear inside XLA device profiles
+  (`--profile_dir`);
+- enters `jax.profiler.TraceAnnotation(name)`, so host-side spans show up
+  on the profiler's host timeline next to the device rows.
+
+Recording happens on ``__exit__`` regardless of whether the body raised,
+so a span around a failing step still reports its elapsed time.
+
+The off switch is structural, not conditional: when the module tracer is
+disabled, ``span()`` returns one shared inert context manager — no clock
+read, no named_scope, no allocation — so a telemetry-off program traces to
+a byte-identical jaxpr (proven by tests/test_telemetry.py against the
+analysis retrace hash). Spans are HOST-side objects: they may *wrap*
+traced code (they fire once per trace), but must never appear inside codec
+bodies — the `ast-span-outside-host` lint rule pins that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import ExitStack
+from typing import Any, Dict, List, Optional
+
+import jax
+
+try:  # host-timeline annotation; absent on some jax builds
+    _TraceAnnotation = jax.profiler.TraceAnnotation
+except AttributeError:  # pragma: no cover - version drift guard
+    _TraceAnnotation = None
+
+
+class _Span:
+    """One live span: wall clock + named_scope + profiler annotation."""
+
+    __slots__ = ("_tracer", "name", "_t0", "_stack")
+
+    def __init__(self, tracer: "Tracer", name: str):
+        self._tracer = tracer
+        self.name = name
+
+    def __enter__(self) -> "_Span":
+        self._stack = ExitStack()
+        self._stack.enter_context(jax.named_scope(self.name))
+        if _TraceAnnotation is not None:
+            self._stack.enter_context(_TraceAnnotation(self.name))
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        # elapsed is taken first and recorded unconditionally: a raising
+        # body still reports (the satellite contract metrics.timed shares)
+        elapsed = time.perf_counter() - self._t0
+        try:
+            self._stack.close()
+        finally:
+            self._tracer._record(self.name, self._t0, elapsed)
+        return False
+
+
+class _NullSpan:
+    """The disabled fast path: one shared, stateless, inert context
+    manager. Returning this (instead of branching inside a live span)
+    is what makes telemetry-off provably free."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Accumulates Chrome-trace-event records (µs, "X" complete events).
+
+    Thread-safe append; per-thread events carry their thread id as `tid`
+    so concurrent host work nests correctly in the viewer."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self.events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._epoch = time.perf_counter()
+        self._pid = os.getpid()
+
+    # ------------------------------------------------------------------ #
+
+    def span(self, name: str):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name)
+
+    def counter(self, name: str, values: Dict[str, float], ts: Optional[float] = None) -> None:
+        """Record a Chrome "C" counter sample (e.g. per-step rel_volume)."""
+        if not self.enabled:
+            return
+        now = ts if ts is not None else time.perf_counter()
+        ev = {
+            "name": name,
+            "ph": "C",
+            "ts": round((now - self._epoch) * 1e6, 3),
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+            "args": {k: float(v) for k, v in values.items()},
+        }
+        with self._lock:
+            self.events.append(ev)
+
+    def _record(self, name: str, t0: float, elapsed: float) -> None:
+        ev = {
+            "name": name,
+            "cat": "telemetry",
+            "ph": "X",
+            "ts": round((t0 - self._epoch) * 1e6, 3),
+            "dur": round(elapsed * 1e6, 3),
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+        }
+        with self._lock:
+            self.events.append(ev)
+
+    # ------------------------------------------------------------------ #
+
+    def reset(self) -> None:
+        with self._lock:
+            self.events = []
+        self._epoch = time.perf_counter()
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """The Perfetto-loadable trace object."""
+        with self._lock:
+            events = list(self.events)
+        # viewers sort more cheaply than they merge; emit time-ordered
+        events.sort(key=lambda e: e["ts"])
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+
+
+# ---------------------------------------------------------------------- #
+# module-level tracer: the one instrumented modules talk to
+# ---------------------------------------------------------------------- #
+
+_tracer = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer.enabled
+
+
+def configure(*, enabled: Optional[bool] = None, reset: bool = False) -> Tracer:
+    """Flip the global tracer on/off and/or clear its event buffer."""
+    if reset:
+        _tracer.reset()
+    if enabled is not None:
+        _tracer.enabled = bool(enabled)
+    return _tracer
+
+
+def span(name: str):
+    """`with span("exchange/encode"): ...` — records wall time + labels the
+    XLA profile when telemetry is on; a shared inert no-op when off."""
+    if not _tracer.enabled:
+        return _NULL_SPAN
+    return _Span(_tracer, name)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
 import os
 import pathlib
 import time
@@ -31,10 +32,14 @@ def _jsonable(obj: Any) -> Any:
     if isinstance(obj, (list, tuple)):
         return [_jsonable(v) for v in obj]
     if hasattr(obj, "ndim"):  # numpy / jax arrays and scalars
-        return obj.item() if obj.ndim == 0 else _jsonable(obj.tolist())
+        return _jsonable(obj.item()) if obj.ndim == 0 else _jsonable(obj.tolist())
     if hasattr(obj, "item"):  # other 0-d scalar wrappers
-        return obj.item()
-    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return _jsonable(obj.item())
+    if isinstance(obj, float):
+        # json.dumps emits bare NaN/Infinity for non-finite floats, which
+        # is not strict JSON and breaks history() consumers — map to null
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, (str, int, bool)) or obj is None:
         return obj
     return str(obj)
 

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from deepreduce_tpu.comm import GradientExchanger
 from deepreduce_tpu.config import DeepReduceConfig
 from deepreduce_tpu.metrics import WireStats
+from deepreduce_tpu.telemetry import MetricAccumulators, spans
 
 
 @jax.tree_util.register_dataclass
@@ -67,24 +68,33 @@ def make_worker_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
     exchanger: GradientExchanger,
+    *,
+    telemetry: bool = False,
 ) -> Callable:
     """The per-worker spmd step (call inside shard_map over the exchanger's
-    axis)."""
+    axis). With `telemetry=True` the step takes and returns a
+    `MetricAccumulators` pytree as an extra carry — all telemetry
+    quantities are collective-reduced on device, so the accumulator stays
+    replicated and the hot loop never syncs to host."""
     axis = exchanger.axis_name
 
-    def step_fn(state: TrainState, batch, key: jax.Array):
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, state.batch_stats, batch
-        )
+    def step_fn(state: TrainState, batch, key: jax.Array, acc=None):
+        with spans.span("train/forward_backward"):
+            (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, state.batch_stats, batch
+            )
         loss = jax.lax.pmean(loss, axis)
         if new_stats:
             new_stats = jax.lax.pmean(new_stats, axis)
 
-        agg, new_residuals, wire = exchanger.exchange(
-            grads, state.residuals, step=state.step, key=key
-        )
-        updates, new_opt = optimizer.update(agg, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        collect = {} if telemetry else None
+        with spans.span("train/exchange"):
+            agg, new_residuals, wire = exchanger.exchange(
+                grads, state.residuals, step=state.step, key=key, collect=collect
+            )
+        with spans.span("train/apply_updates"):
+            updates, new_opt = optimizer.update(agg, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         wire_mean = WireStats(
             index_bits=jax.lax.pmean(wire.index_bits.astype(jnp.float32), axis),
             value_bits=jax.lax.pmean(wire.value_bits.astype(jnp.float32), axis),
@@ -100,7 +110,41 @@ def make_worker_step(
             residuals=new_residuals,
             step=state.step + 1,
         )
-        return new_state, loss, wire_mean
+        if not telemetry:
+            return new_state, loss, wire_mean
+
+        # --- telemetry accumulator update (all collective-reduced) ------ #
+        from jax.flatten_util import ravel_pytree
+
+        # compression error vs. the dense mean gradient: what a lossless
+        # allreduce would have applied, one extra pmean per step
+        dense_mean = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g.astype(jnp.float32), axis), grads
+        )
+        af, _ = ravel_pytree(
+            jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), agg)
+        )
+        df, _ = ravel_pytree(dense_mean)
+        ref = jnp.linalg.norm(df)
+        err_l2 = jnp.linalg.norm(af - df) / jnp.maximum(ref, 1e-12)
+        err_cos = jnp.vdot(af, df) / jnp.maximum(jnp.linalg.norm(af) * ref, 1e-12)
+        if new_residuals is not None:
+            res_sq = sum(
+                jnp.sum(jnp.square(r.astype(jnp.float32)))
+                for r in jax.tree_util.tree_leaves(new_residuals)
+            )
+            residual_l2 = jax.lax.pmean(jnp.sqrt(res_sq), axis)
+        else:
+            residual_l2 = jnp.zeros((), jnp.float32)
+        new_acc = acc.accumulate(
+            wire_mean,
+            residual_l2=residual_l2,
+            err_l2=err_l2,
+            err_cos=err_cos,
+            fp_count=jax.lax.psum(collect["fp_count"], axis),
+            fp_universe=jax.lax.psum(collect["fp_universe"], axis),
+        )
+        return new_state, loss, wire_mean, new_acc
 
     return step_fn
 
@@ -127,6 +171,8 @@ class Trainer:
         self.loss_fn = loss_fn or classification_loss(model)
         self.exchanger: Optional[GradientExchanger] = None
         self._step_fn = None
+        self._raw_step_fn = None  # unjitted shard_map'd fn (audit hook)
+        self._telemetry_acc: Optional[MetricAccumulators] = None
 
     @property
     def num_workers(self) -> int:
@@ -158,18 +204,49 @@ class Trainer:
         )
 
     def _build(self, has_residuals: bool):
-        worker_step = make_worker_step(self.loss_fn, self.optimizer, self.exchanger)
+        telemetry = bool(self.cfg.telemetry)
+        worker_step = make_worker_step(
+            self.loss_fn, self.optimizer, self.exchanger, telemetry=telemetry
+        )
         axis = self.axis_name
 
-        def spmd(state_nores, residuals, batch, key):
-            if residuals is not None:
-                residuals = jax.tree_util.tree_map(lambda r: r[0], residuals)
-            state = dataclasses.replace(state_nores, residuals=residuals)
-            new_state, loss, wire = worker_step(state, batch, key)
-            new_res = new_state.residuals
-            if new_res is not None:
-                new_res = jax.tree_util.tree_map(lambda r: r[None], new_res)
-            return dataclasses.replace(new_state, residuals=None), new_res, loss, wire
+        # the telemetry accumulator is an extra replicated carry that only
+        # exists when cfg.telemetry is on — the off program is built from
+        # the identical source path with no extra args, so its jaxpr is
+        # byte-identical to a build without telemetry (pinned by
+        # tests/test_telemetry.py via the analysis retrace hash)
+        if telemetry:
+
+            def spmd(state_nores, residuals, batch, key, acc):
+                if residuals is not None:
+                    residuals = jax.tree_util.tree_map(lambda r: r[0], residuals)
+                state = dataclasses.replace(state_nores, residuals=residuals)
+                new_state, loss, wire, new_acc = worker_step(state, batch, key, acc)
+                new_res = new_state.residuals
+                if new_res is not None:
+                    new_res = jax.tree_util.tree_map(lambda r: r[None], new_res)
+                return (
+                    dataclasses.replace(new_state, residuals=None),
+                    new_res,
+                    loss,
+                    wire,
+                    new_acc,
+                )
+
+            extra_in, extra_out = (P(),), (P(),)
+        else:
+
+            def spmd(state_nores, residuals, batch, key):
+                if residuals is not None:
+                    residuals = jax.tree_util.tree_map(lambda r: r[0], residuals)
+                state = dataclasses.replace(state_nores, residuals=residuals)
+                new_state, loss, wire = worker_step(state, batch, key)
+                new_res = new_state.residuals
+                if new_res is not None:
+                    new_res = jax.tree_util.tree_map(lambda r: r[None], new_res)
+                return dataclasses.replace(new_state, residuals=None), new_res, loss, wire
+
+            extra_in, extra_out = (), ()
 
         res_spec = P(axis) if has_residuals else P()
         from deepreduce_tpu.utils.compat import shard_map
@@ -177,19 +254,41 @@ class Trainer:
         fn = shard_map(
             spmd,
             mesh=self.mesh,
-            in_specs=(P(), res_spec, P(axis), P()),
-            out_specs=(P(), res_spec, P(), P()),
+            in_specs=(P(), res_spec, P(axis), P()) + extra_in,
+            out_specs=(P(), res_spec, P(), P()) + extra_out,
             check_vma=False,
         )
+        self._raw_step_fn = fn  # unjitted, for make_jaxpr-based audits
         return jax.jit(fn)
 
     def step(self, state: TrainState, batch, key: jax.Array):
         """One synchronous DP step. batch's leading dim is the global batch,
         split over the data axis."""
         if self._step_fn is None:
-            self._step_fn = self._build(state.residuals is not None)
+            with spans.span("train/build"):
+                self._step_fn = self._build(state.residuals is not None)
         state_nores = dataclasses.replace(state, residuals=None)
-        new_nores, new_res, loss, wire = self._step_fn(
-            state_nores, state.residuals, batch, key
-        )
+        if self.cfg.telemetry:
+            if self._telemetry_acc is None:
+                self._telemetry_acc = MetricAccumulators.zeros()
+            new_nores, new_res, loss, wire, self._telemetry_acc = self._step_fn(
+                state_nores, state.residuals, batch, key, self._telemetry_acc
+            )
+        else:
+            new_nores, new_res, loss, wire = self._step_fn(
+                state_nores, state.residuals, batch, key
+            )
         return dataclasses.replace(new_nores, residuals=new_res), loss, wire
+
+    @property
+    def telemetry(self) -> Optional[MetricAccumulators]:
+        """The live on-device accumulator (None until the first telemetry
+        step, or when cfg.telemetry is off)."""
+        return self._telemetry_acc
+
+    def telemetry_summary(self) -> dict:
+        """Fetch the accumulators to host (the telemetry_every sync point);
+        {} when telemetry is off or no step has run."""
+        if self._telemetry_acc is None:
+            return {}
+        return self._telemetry_acc.summary()

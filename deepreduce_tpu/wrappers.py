@@ -357,6 +357,17 @@ class TensorCodec:
                 print(f"{k}:{v}")
         return out
 
+    def fp_stats(self, payload: Any) -> Optional[Tuple[jax.Array, jax.Array]]:
+        """Measured index-codec false positives for telemetry:
+        (fp_count, not_selected_universe) traced scalars, or None when the
+        index codec is exact (no FP notion) or bypassed for this tensor."""
+        if self.dense_fallback or not self.compressed:
+            return None
+        if self.idx_codec is None or not hasattr(self.idx_codec, "fp_stats"):
+            return None
+        ipay = payload.index_payload if isinstance(payload, BothPayload) else payload
+        return self.idx_codec.fp_stats(ipay)
+
     def _saturation(self, index_payload: Any) -> jax.Array:
         """1.0 when the index payload's selection filled its whole static
         budget (nsel == budget) — the silent-truncation signal for the

@@ -90,6 +90,38 @@ def test_ast_catches_python_branch_on_traced_value():
     _only(lint_source(src, "deepreduce_tpu/codecs/fake.py"), ast_lint.R_AST_BRANCH)
 
 
+def test_ast_catches_span_in_codec_module():
+    src = (
+        "from deepreduce_tpu.telemetry import spans\n"
+        "def encode(x):\n"
+        "    with spans.span('encode/inner'):\n"
+        "        return x * 2\n"
+    )
+    _only(lint_source(src, "deepreduce_tpu/codecs/fake.py"), ast_lint.R_AST_SPAN)
+    # the identical source is fine in the communicator layer — spans belong
+    # around traced regions, not inside them
+    assert lint_source(src, "deepreduce_tpu/comm.py") == []
+
+
+def test_ast_catches_dump_logger_in_codec_module():
+    src = (
+        "from somewhere import DumpLogger\n"
+        "def decode(p):\n"
+        "    DumpLogger('decode').write(p)\n"
+        "    return p\n"
+    )
+    violations = lint_source(src, "deepreduce_tpu/codecs/fake.py")
+    assert violations, "DumpLogger construction in codecs/ must be flagged"
+    assert all(v.rule == ast_lint.R_AST_SPAN for v in violations)
+
+
+def test_ast_span_rule_ignores_local_variable_named_span():
+    # codecs/polyseg.py uses `span` as a local float — assignments and
+    # arithmetic on a name are not telemetry calls
+    src = "def fit(lo, hi):\n    span = hi - lo\n    return span / 2\n"
+    assert lint_source(src, "deepreduce_tpu/codecs/fake.py") == []
+
+
 def test_ast_rules_scope_correctly():
     # host entropy is fine in untraced tooling; compat module may import
     # shard_map directly (it IS the shim)

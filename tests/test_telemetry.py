@@ -1,0 +1,355 @@
+"""Telemetry subsystem tests: span tracing emits valid Chrome traces, the
+on-device accumulators agree with the per-step WireStats the trainer already
+reports, telemetry-off compiles to a byte-identical program (pinned with the
+analysis retrace hash), and the offline CLI consumes tracking run dirs.
+Plus the observability satellites: metrics.timed and tracking._jsonable."""
+
+import contextlib
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from conftest import shared_mesh
+from deepreduce_tpu import metrics, tracking
+from deepreduce_tpu.analysis.rules import jaxpr_hash
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.telemetry import MetricAccumulators, Tracer, spans
+from deepreduce_tpu.telemetry import __main__ as cli
+from deepreduce_tpu.train import Trainer
+
+from test_train import TinyMLP, _data
+
+
+# ---------------------------------------------------------------------- #
+# span tracing
+# ---------------------------------------------------------------------- #
+
+
+def test_tracer_emits_valid_chrome_trace(tmp_path):
+    tr = Tracer(enabled=True)
+    with tr.span("outer"):
+        with tr.span("outer/inner"):
+            pass
+    with pytest.raises(RuntimeError):
+        with tr.span("outer/raises"):
+            raise RuntimeError("boom")
+    tr.counter("wire", {"rel_volume": 0.1})
+
+    trace = tr.to_chrome_trace()
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    events = trace["traceEvents"]
+    spans_x = [e for e in events if e["ph"] == "X"]
+    # the raising body is still recorded (span records on __exit__)
+    assert {e["name"] for e in spans_x} == {"outer", "outer/inner", "outer/raises"}
+    for e in spans_x:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0.0
+    counters = [e for e in events if e["ph"] == "C"]
+    assert counters and counters[0]["args"] == {"rel_volume": 0.1}
+    # events come out time-ordered, and the inner span nests in the outer
+    assert [e["ts"] for e in events] == sorted(e["ts"] for e in events)
+    outer = next(e for e in spans_x if e["name"] == "outer")
+    inner = next(e for e in spans_x if e["name"] == "outer/inner")
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+    path = tmp_path / "trace.json"
+    tr.save(path)
+    assert json.loads(path.read_text())["traceEvents"]
+
+
+def test_disabled_span_is_shared_noop():
+    tr = Tracer(enabled=False)
+    a, b = tr.span("x"), tr.span("y")
+    assert a is b  # one shared inert object, no per-call allocation
+    with a:
+        pass
+    assert tr.events == []
+    # the module-level path behaves identically when the global tracer is off
+    assert not spans.enabled()
+    assert spans.span("anything") is spans.span("other")
+
+
+def test_configure_reset_clears_events():
+    tr = spans.configure(enabled=True, reset=True)
+    try:
+        with spans.span("probe"):
+            pass
+        assert len(tr.events) == 1
+    finally:
+        spans.configure(enabled=False, reset=True)
+    assert tr.events == []
+
+
+# ---------------------------------------------------------------------- #
+# on-device accumulators vs. per-step WireStats
+# ---------------------------------------------------------------------- #
+
+
+def _fit_telemetry(cfg, steps=5, batch=64, workers=8):
+    mesh = shared_mesh(workers)
+    trainer = Trainer(TinyMLP(), cfg, optax.sgd(0.1), mesh)
+    x, y = _data()
+    state = trainer.init_state(jax.random.PRNGKey(0), (x[:batch], y[:batch]))
+    key = jax.random.PRNGKey(1)
+    wires = []
+    for i in range(steps):
+        lo = (i * batch) % (len(x) - batch)
+        state, loss, wire = trainer.step(
+            state, (x[lo : lo + batch], y[lo : lo + batch]), jax.random.fold_in(key, i)
+        )
+        wires.append(jax.tree_util.tree_map(float, wire))
+    return trainer, wires
+
+
+BLOOM_CFG = dict(
+    deepreduce="index",
+    index="bloom",
+    compress_ratio=0.05,
+    fpr=0.01,
+    memory="residual",
+    min_compress_size=100,
+    telemetry=True,
+)
+QSGD_CFG = dict(
+    deepreduce="value",
+    value="qsgd",
+    compress_ratio=0.05,
+    memory="residual",
+    min_compress_size=100,
+    telemetry=True,
+)
+
+
+@pytest.mark.parametrize("cfg_kw", [BLOOM_CFG, QSGD_CFG], ids=["bloom", "qsgd"])
+def test_accumulators_match_wirestats_sums(cfg_kw):
+    steps = 5
+    trainer, wires = _fit_telemetry(DeepReduceConfig(**cfg_kw), steps=steps)
+    summ = trainer.telemetry_summary()
+
+    assert summ["steps"] == steps
+    total_bits = sum(w.index_bits + w.value_bits for w in wires)
+    dense_bits = sum(w.dense_bits for w in wires)
+    assert summ["cumulative_total_bits"] == pytest.approx(total_bits, rel=1e-4)
+    assert summ["rel_volume"] == pytest.approx(total_bits / dense_bits, rel=1e-4)
+    # dense_bits is step-constant, so the cumulative ratio equals the mean
+    # of the per-step ratios
+    per_step = [
+        (w.index_bits + w.value_bits) / w.dense_bits for w in wires
+    ]
+    assert summ["rel_volume"] == pytest.approx(np.mean(per_step), rel=1e-4)
+    assert 0.0 < summ["rel_volume"] < 1.0
+    assert math.isfinite(summ["compress_err_l2"])
+    assert -1.0 <= summ["compress_err_cos"] <= 1.0 + 1e-6
+    if cfg_kw["deepreduce"] == "index":
+        # bloom: the decoder reconstructs false positives, the accumulator
+        # sees them — the measured FPR is in the ballpark of the configured
+        # one (generously bounded; it's a probabilistic quantity)
+        assert 0.0 < summ["measured_fpr"] < 20 * cfg_kw["fpr"] + 0.05
+    else:
+        assert summ["measured_fpr"] == 0.0  # value-only path has no bloom
+
+
+def test_telemetry_accumulator_survives_across_steps():
+    trainer, _ = _fit_telemetry(DeepReduceConfig(**QSGD_CFG), steps=3)
+    acc = trainer.telemetry
+    assert isinstance(acc, MetricAccumulators)
+    assert float(acc.steps) == 3.0
+    # and another fetch is idempotent
+    assert trainer.telemetry_summary()["steps"] == 3.0
+
+
+# ---------------------------------------------------------------------- #
+# disabled == absent: byte-identical step program
+# ---------------------------------------------------------------------- #
+
+
+def _step_jaxpr_hash():
+    """Trace the (unjitted) shard_map'd step and hash its jaxpr."""
+    cfg = DeepReduceConfig(
+        deepreduce="index",
+        index="bloom",
+        compress_ratio=0.05,
+        fpr=0.01,
+        memory="residual",
+        min_compress_size=100,
+        telemetry=False,
+    )
+    mesh = shared_mesh(4)
+    trainer = Trainer(TinyMLP(), cfg, optax.sgd(0.1), mesh)
+    x, y = _data(n=64)
+    state = trainer.init_state(jax.random.PRNGKey(0), (x[:32], y[:32]))
+    trainer._build(state.residuals is not None)
+    import dataclasses
+
+    state_nores = dataclasses.replace(state, residuals=None)
+    closed = jax.make_jaxpr(trainer._raw_step_fn)(
+        state_nores, state.residuals, (x[:32], y[:32]), jax.random.PRNGKey(1)
+    )
+    return jaxpr_hash(closed)
+
+
+def test_telemetry_off_jaxpr_identical_to_absent(monkeypatch):
+    """cfg.telemetry=False must cost literally nothing: the step program
+    with real (disabled) spans hashes identically to one where every span
+    call is replaced by a bare nullcontext — i.e. disabled == absent."""
+    h_disabled = _step_jaxpr_hash()
+    monkeypatch.setattr(spans, "span", lambda name: contextlib.nullcontext())
+    h_absent = _step_jaxpr_hash()
+    assert h_disabled == h_absent
+
+
+# ---------------------------------------------------------------------- #
+# CLI
+# ---------------------------------------------------------------------- #
+
+
+def _write_run(root, name, *, dt=0.1, n=6, config=None, telemetry=None,
+               trace_events=None):
+    """Hand-written tracking run dir with controlled step-time spacing."""
+    d = root / name
+    d.mkdir(parents=True)
+    (d / "config.json").write_text(
+        json.dumps({"name": name, "tags": [], "config": config or {}})
+    )
+    with open(d / "metrics.jsonl", "w") as f:
+        for i in range(n):
+            rec = {"step": i, "ts": 1000.0 + i * dt, "loss": 2.0 - 0.1 * i,
+                   "rel_volume": 0.08}
+            f.write(json.dumps(rec) + "\n")
+    summary = {"last_loss": 2.0 - 0.1 * (n - 1)}
+    if telemetry is not None:
+        summary["telemetry"] = telemetry
+    (d / "summary.json").write_text(json.dumps(summary))
+    if trace_events is not None:
+        (d / "trace.json").write_text(
+            json.dumps({"traceEvents": trace_events, "displayTimeUnit": "ms"})
+        )
+    return d
+
+
+def test_cli_summary(tmp_path, capsys):
+    _write_run(tmp_path, "runA", telemetry={"steps": 5.0, "rel_volume": 0.08})
+    # a tracking ROOT resolves to its latest run
+    assert cli.main(["summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "runA" in out and "rel_volume" in out and "device accumulators" in out
+    assert cli.main(["summary", str(tmp_path / "runA"), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["steps_logged"] == 6
+    assert rep["telemetry"]["steps"] == 5.0
+    assert rep["step_time_s"]["mean"] == pytest.approx(0.1, rel=1e-6)
+
+
+def test_cli_summary_missing_run(tmp_path):
+    assert cli.main(["summary", str(tmp_path / "nope")]) == 2
+
+
+def test_cli_compare_two_runs(tmp_path, capsys):
+    a = _write_run(tmp_path, "fast", dt=0.1)
+    b = _write_run(tmp_path, "slow", dt=0.5)
+    assert cli.main(["compare", str(a), str(b)]) == 1  # 5x slower: regression
+    assert "REGRESSION" in capsys.readouterr().out
+    assert cli.main(["compare", str(a), str(a)]) == 0
+    assert cli.main(["compare", str(b), str(a)]) == 0  # faster is fine
+
+
+def test_cli_compare_against_bench(tmp_path, capsys):
+    bench = tmp_path / "BENCH_DECODE_fake.json"
+    bench.write_text(
+        json.dumps({"detail": {"strategies": {"loop": {"t_step_s": 0.1}}}})
+    )
+    slow = _write_run(tmp_path, "slow", dt=0.5)
+    fast = _write_run(tmp_path, "fast", dt=0.05)
+    assert cli.main(["compare", str(slow), "--against", str(bench)]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    assert cli.main(["compare", str(fast), "--against", str(bench)]) == 0
+    # a run pinned to a strategy the record lacks is a data error, not a pass
+    other = _write_run(tmp_path, "other", dt=0.05,
+                       config={"decode_strategy": "vmap"})
+    assert cli.main(["compare", str(other), "--against", str(bench)]) == 2
+
+
+def test_cli_trace_merges_spans_and_counters(tmp_path, capsys):
+    tr = Tracer(enabled=True)
+    with tr.span("train/step"):
+        pass
+    run = _write_run(tmp_path, "traced",
+                     trace_events=tr.to_chrome_trace()["traceEvents"])
+    out = tmp_path / "merged.json"
+    assert cli.main(["trace", str(run), "--out", str(out)]) == 0
+    merged = json.loads(out.read_text())
+    names = {e["name"] for e in merged["traceEvents"]}
+    assert "train/step" in names  # the span row
+    assert "loss" in names and "rel_volume" in names  # metric counter rows
+    phases = {e["ph"] for e in merged["traceEvents"]}
+    assert phases == {"X", "C"}
+    # without trace.json the metrics alone still produce a trace
+    capsys.readouterr()  # drain the "wrote N events" line
+    bare = _write_run(tmp_path, "bare")
+    assert cli.main(["trace", str(bare)]) == 0
+    merged = json.loads(capsys.readouterr().out)
+    assert all(e["ph"] == "C" for e in merged["traceEvents"])
+
+
+# ---------------------------------------------------------------------- #
+# satellites: metrics.timed and tracking._jsonable
+# ---------------------------------------------------------------------- #
+
+
+def test_timed_sink_records_silently(capsys):
+    sink = {}
+    with metrics.timed("enc", sink=sink):
+        pass
+    with metrics.timed("enc", sink=sink):
+        pass
+    assert sink["enc"] > 0.0
+    assert capsys.readouterr().out == ""  # sink means no console spam
+
+
+def test_timed_records_on_raise(capsys):
+    sink = {}
+    with pytest.raises(ValueError):
+        with metrics.timed("boom", sink=sink):
+            raise ValueError
+    assert sink["boom"] > 0.0
+    with pytest.raises(ValueError):
+        with metrics.timed("loud"):
+            raise ValueError
+    assert "loud time:" in capsys.readouterr().out
+
+
+def test_timed_print_only_when_enabled(capsys):
+    with metrics.timed("quiet", enabled=False):
+        pass
+    assert capsys.readouterr().out == ""
+    with metrics.timed("loud"):
+        pass
+    assert "loud time:" in capsys.readouterr().out
+
+
+def test_jsonable_maps_nonfinite_to_null():
+    rec = tracking._jsonable(
+        {"a": float("nan"), "b": float("inf"), "c": -float("inf"),
+         "d": np.float32("nan"), "e": jnp.asarray(float("nan")),
+         "f": 1.5, "g": [float("nan"), 2]}
+    )
+    assert rec == {"a": None, "b": None, "c": None, "d": None, "e": None,
+                   "f": 1.5, "g": [None, 2]}
+    # and the emitted line is strict JSON (bare NaN would blow up here)
+    json.loads(json.dumps(rec, allow_nan=False))
+
+
+def test_run_log_emits_strict_json(tmp_path):
+    run = tracking.Run(str(tmp_path), name="strict")
+    run.log({"loss": float("nan"), "ok": 1.0}, step=0)
+    run.finish({"last": float("inf")})
+    lines = (tmp_path / "strict" / "metrics.jsonl").read_text().splitlines()
+    rec = json.loads(lines[0])  # parses strictly
+    assert rec["loss"] is None and rec["ok"] == 1.0
+    assert json.loads((tmp_path / "strict" / "summary.json").read_text())["last"] is None

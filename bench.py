@@ -62,6 +62,41 @@ def _span(name: str):
     return spans.span(name)
 
 
+DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024  # 4 MB fusion buckets (Horovod-scale)
+
+
+def _bucket_bytes_arg() -> int:
+    """`--bucket-bytes N`: bucket budget for the bucketed-exchange arm.
+    Raw-sys.argv style like --quick/--trace-out; the value is routed into
+    the config through `from_params(strict=True)` so a bad knob fails
+    loudly in the subprocess."""
+    if "--bucket-bytes" in sys.argv:
+        i = sys.argv.index("--bucket-bytes")
+        if i + 1 < len(sys.argv):
+            return int(sys.argv[i + 1])
+    return DEFAULT_BUCKET_BYTES
+
+
+def lstm_leafy_shapes() -> dict:
+    """name -> flat size: the StackOverflow LSTM census as the LEAFY pytree
+    the paper actually trains (Table 2) — per-gate kernel/recurrent/bias
+    plus per-gate layernorm leaves instead of one fused (d,) blob. ~4.05M
+    params across 22 leaves, most of them tiny: the shape where per-leaf
+    codec overhead is O(leaves) and the bucketed exchange should win."""
+    shapes = {"embedding": 10_004 * 96}
+    for gate in ("i", "f", "g", "o"):
+        shapes[f"lstm/kernel_{gate}"] = 96 * 670
+        shapes[f"lstm/recurrent_{gate}"] = 670 * 670
+        shapes[f"lstm/bias_{gate}"] = 670
+        shapes[f"lstm/ln_scale_{gate}"] = 670
+        shapes[f"lstm/ln_bias_{gate}"] = 670
+    shapes["proj/kernel"] = 670 * 96
+    shapes["proj/bias"] = 96
+    shapes["output/kernel"] = 96 * 10_004
+    shapes["output/bias"] = 10_004
+    return shapes
+
+
 def _trace_out_path():
     """`--trace-out PATH`: save a Chrome trace of the bench phases there.
     Raw-sys.argv style like --quick/--decode-sweep, and forwarded verbatim
@@ -442,6 +477,105 @@ print(json.dumps({{
     return {}
 
 
+def _bucketed_subprocess(
+    bucket_bytes: int, workers: int = 8, timeout: int = 900
+) -> dict:
+    """The `drqsgd_bloom_bucketed` arm: the flagship bloom+qsgd exchange on
+    the LEAFY LSTM census (lstm_leafy_shapes — 22 leaves, most tiny),
+    per-tensor fused vs bucketed at `bucket_bytes`, on the virtual 8-way
+    CPU mesh in a timeout-guarded subprocess. The per-tensor arm pays one
+    codec per leaf; the bucketed arm pays one per bucket — the
+    O(leaves)→O(buckets) encode win, measured. Configs are built through
+    `from_params(strict=True)` so a misspelled knob fails loudly."""
+    import os
+    import subprocess
+
+    from deepreduce_tpu.utils import host_device_count_flags
+
+    shapes = lstm_leafy_shapes()
+    code = f"""
+import json, time, numpy as np
+from deepreduce_tpu.utils import force_platform
+force_platform('cpu', device_count={workers})
+import jax, jax.numpy as jnp
+from deepreduce_tpu.utils.compat import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+from deepreduce_tpu.comm import GradientExchanger
+from deepreduce_tpu.config import from_params
+from deepreduce_tpu.utils import enable_compile_cache
+enable_compile_cache()
+shapes, nw = {shapes!r}, {workers}
+def sync(x):
+    for leaf in jax.tree_util.tree_leaves(x):
+        if getattr(leaf, "size", 0):
+            np.asarray(leaf.reshape(-1)[0]); return x
+    return x
+def timeit(fn, *args, iters=4, reps=6):
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(reps)]
+        for o in outs:
+            sync(o)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return max(best, 1e-6)
+base = dict(compressor="topk", compress_ratio=0.10, deepreduce="both",
+            index="bloom", value="qsgd", policy="p0", fpr=0.02,
+            memory="none", approx_topk=True, bloom_blocked="mod",
+            fused=True, use_pallas=True)
+rng = np.random.default_rng(0)
+grads = {{n: jnp.asarray(rng.normal(size=s), jnp.float32)
+          for n, s in shapes.items()}}
+mesh = Mesh(np.array(jax.devices()[:nw]), ("data",))
+out = {{}}
+for arm, extra in (("drqsgd_bloom_pertensor", {{}}),
+                   ("drqsgd_bloom_bucketed", {{"bucket_bytes": {bucket_bytes}}})):
+    cfg = from_params({{**base, **extra}}, strict=True)
+    ex = GradientExchanger(grads, cfg, axis_name="data", num_workers=nw)
+    def spmd(g, _ex=ex):
+        agg, _, wire = _ex.exchange(g, None, step=jnp.zeros((), jnp.int32),
+                                    key=jax.random.PRNGKey(0))
+        return agg, wire
+    fn = jax.jit(shard_map(spmd, mesh=mesh, in_specs=(P(),),
+                           out_specs=(P(), P()), check_vma=False))
+    agg, wire = fn(grads)
+    sync(agg)
+    t = timeit(fn, grads)
+    out[arm] = {{"t_step_s": round(t, 4),
+                 "num_buckets": ex.num_buckets,
+                 "wire_bytes_per_worker": ex.payload_bytes(grads)}}
+pt = out["drqsgd_bloom_pertensor"]["t_step_s"]
+bk = out["drqsgd_bloom_bucketed"]["t_step_s"]
+print(json.dumps({{
+    "leaves": len(shapes), "d": int(sum(shapes.values())), "workers": nw,
+    "bucket_bytes": {bucket_bytes}, "arms": out,
+    "bucketed_speedup_vs_pertensor": round(pt / bk, 3)}}))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = host_device_count_flags(env.get("XLA_FLAGS", ""), workers)
+    try:
+        _progress(f"bucketed exchange: {workers}-CPU mesh subprocess")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, text=True,
+            capture_output=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode == 0:
+            rec = _last_json_line(proc.stdout)
+            if rec is not None:
+                return rec
+            _progress("bucketed exchange produced no JSON record")
+        else:
+            _progress(
+                f"bucketed exchange failed rc={proc.returncode}: "
+                f"{proc.stderr[-300:]}"
+            )
+    except Exception as e:  # noqa: BLE001 — bench must not die on a probe
+        _progress(f"bucketed exchange skipped: {e}")
+    return {}
+
+
 def decode_strategy_sweep(d: int = LSTM_D, workers: int = 8) -> dict:
     """The fused-exchange decode-strategy sweep arm: the SAME flagship
     bloom+qsgd exchange measured under all three cfg.decode_strategy values
@@ -489,6 +623,24 @@ def main() -> None:
                         "config": "drqsgd_bloom (topk 10%, bloom P0 fpr=0.02, qsgd)",
                         "strategies": sweep,
                     },
+                }
+            )
+        )
+        return
+    if "--bucketed-sweep" in sys.argv:
+        # standalone bucketed-exchange mode: CPU-mesh only, one JSON record
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu")
+        rec = _bucketed_subprocess(_bucket_bytes_arg())
+        print(
+            json.dumps(
+                {
+                    "metric": "bucketed_exchange_speedup_vs_pertensor",
+                    "value": rec.get("bucketed_speedup_vs_pertensor"),
+                    "unit": "x",
+                    "platform": "cpu",
+                    "detail": rec,
                 }
             )
         )
@@ -694,6 +846,14 @@ def main() -> None:
                 detail["decode_strategy_sweep"] = decode_strategy_sweep()
         except Exception as e:  # noqa: BLE001
             _progress(f"decode strategy sweep failed: {e}")
+        # per-tensor vs bucketed fused exchange on the leafy LSTM census
+        try:
+            with _span("bench/bucketed-exchange"):
+                detail["bucketed_exchange"] = _bucketed_subprocess(
+                    _bucket_bytes_arg()
+                )
+        except Exception as e:  # noqa: BLE001
+            _progress(f"bucketed exchange arm failed: {e}")
 
     if not quick and not degraded and "--skip-models" not in sys.argv:
         # (CPU-degraded runs skip this: img/s and MFU of a conv net on the

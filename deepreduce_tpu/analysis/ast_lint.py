@@ -48,6 +48,7 @@ TRACED_MODULES = (
     "deepreduce_tpu/codecs/",
     "deepreduce_tpu/sparse.py",
     "deepreduce_tpu/comm.py",
+    "deepreduce_tpu/comm_bucket.py",
     "deepreduce_tpu/comm_ring.py",
     "deepreduce_tpu/memory.py",
     "deepreduce_tpu/qar.py",

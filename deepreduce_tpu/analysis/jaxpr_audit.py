@@ -222,44 +222,61 @@ def audit_exchange(
     cfg: DeepReduceConfig,
     *,
     d: int = 4096,
+    leaves: Optional[Dict[str, int]] = None,
     expect: Optional[Dict[str, int]] = None,
     wire_mode: Optional[str] = None,
     enforce_sorted: bool = False,
+    expect_codec: Optional[int] = None,
     mesh=None,
 ) -> List[TraceRecord]:
-    """Trace one full `exchange` step inside shard_map on the 8-way mesh."""
+    """Trace one full `exchange` step inside shard_map on the 8-way mesh.
+
+    `leaves` (name -> flat size) swaps the default single-(d,) gradient for
+    a multi-leaf dict pytree — the shape the bucketed-exchange audits need.
+    `expect_codec` arms jx-codec-count: the exact static count of
+    sparsifier-selection eqns (O(leaves) per-tensor, O(buckets) bucketed).
+    """
     from jax.sharding import PartitionSpec as P
 
+    tmap = jax.tree_util.tree_map
     mesh = audit_mesh() if mesh is None else mesh
-    grads_like = _sds((d,))
+    if leaves is None:
+        grads_like: Any = _sds((d,))
+    else:
+        grads_like = {n: _sds((int(sz),)) for n, sz in leaves.items()}
     ex = GradientExchanger(grads_like, cfg, axis_name=AXIS, num_workers=NUM_WORKERS)
     with_state = cfg.memory == "residual"
     pb = ex.payload_bytes(grads_like) if wire_mode is not None else None
+    g_w = tmap(lambda s: _sds((NUM_WORKERS,) + s.shape), grads_like)
 
     if with_state:
 
         def spmd(g, res, step):
-            res0 = jax.tree_util.tree_map(lambda r: r[0], res)
-            agg, new_res, _ = ex.exchange(g[0], res0, step=step)
-            new_res = jax.tree_util.tree_map(lambda r: r[None], new_res)
-            return agg[None], new_res
+            g0 = tmap(lambda x: x[0], g)
+            res0 = tmap(lambda r: r[0], res)
+            agg, new_res, _ = ex.exchange(g0, res0, step=step)
+            new_res = tmap(lambda r: r[None], new_res)
+            return tmap(lambda x: x[None], agg), new_res
 
         fn = _shard_map(
             spmd, mesh, (P(AXIS), P(AXIS), P()), (P(AXIS), P(AXIS))
         )
-        args = (_sds((NUM_WORKERS, d)), _sds((NUM_WORKERS, d)), _STEP)
+        args = (g_w, g_w, _STEP)
     else:
 
         def spmd(g, step):
-            agg, _, _ = ex.exchange(g[0], None, step=step)
-            return agg[None]
+            agg, _, _ = ex.exchange(tmap(lambda x: x[0], g), None, step=step)
+            return tmap(lambda x: x[None], agg)
 
         fn = _shard_map(spmd, mesh, (P(AXIS), P()), P(AXIS))
-        args = (_sds((NUM_WORKERS, d)), _STEP)
+        args = (g_w, _STEP)
 
     budget = None
     if enforce_sorted:
-        codec = next(iter(ex.codecs.values()))
+        codecs = ex.codecs or (
+            ex._bucketed.codecs if ex._bucketed is not None else {}
+        )
+        codec = next(iter(codecs.values()))
         meta = getattr(codec.idx_codec, "meta", None)
         budget = getattr(meta, "budget", codec.k)
     ctx = AuditContext(
@@ -270,6 +287,7 @@ def audit_exchange(
         wire_mode=wire_mode,
         expected_wire_bytes=pb,
         num_workers=NUM_WORKERS,
+        expect_codec_invocations=expect_codec,
     )
     return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
 
@@ -295,6 +313,16 @@ _FLAGSHIP = dict(
     fpr=0.01,
     min_compress_size=100,
 )
+
+# the many-leaf census the bucketed audits trace: one big embedding-style
+# leaf (stays solo) plus five small gate/bias-style leaves. At
+# _BUCKET_BYTES = 4800 B (1200 f32 elements) the deterministic FFD
+# partition is exactly THREE buckets — emb solo, {w1,b1}, {w2,b2,b3} —
+# so the collective inventory pins all_gather == 3 and jx-codec-count
+# pins 3 sparsifier selections for 6 leaves (the O(buckets) claim).
+_BUCKET_LEAVES = {"emb": 3000, "w1": 900, "w2": 700, "b1": 300, "b2": 150, "b3": 50}
+_BUCKET_BYTES = 4800
+_BUCKET_COUNT = 3
 
 
 def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceRecord]]]]:
@@ -322,6 +350,24 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
             expect={"all_gather": 1},
             wire_mode="allgather",
             enforce_sorted=True,
+            # single leaf -> exactly one sparsifier selection (the
+            # O(leaves) baseline jx-codec-count pins)
+            expect_codec=1,
+        ),
+    )
+    add(
+        "exchange:bucketed-loop",
+        lambda: audit_exchange(
+            "exchange:bucketed-loop",
+            C(memory="residual", decode_strategy="loop",
+              bucket_bytes=_BUCKET_BYTES, **_FLAGSHIP),
+            leaves=_BUCKET_LEAVES,
+            # exactly C all_gather eqns whose operand bytes sum to
+            # payload_bytes(), and C codec invocations for 6 leaves
+            expect={"all_gather": _BUCKET_COUNT},
+            wire_mode="allgather",
+            enforce_sorted=True,
+            expect_codec=_BUCKET_COUNT,
         ),
     )
     add(
@@ -425,6 +471,18 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
     )
 
     # --- remaining communicator shapes ---
+    add(
+        "exchange:bucketed-vmap",
+        lambda: audit_exchange(
+            "exchange:bucketed-vmap",
+            C(memory="residual", decode_strategy="vmap", decode_batch=4,
+              bucket_bytes=_BUCKET_BYTES, **_FLAGSHIP),
+            leaves=_BUCKET_LEAVES,
+            expect={"all_gather": _BUCKET_COUNT},
+            wire_mode="allgather",
+            expect_codec=_BUCKET_COUNT,
+        ),
+    )
     add(
         "exchange:per-tensor",
         lambda: audit_exchange(

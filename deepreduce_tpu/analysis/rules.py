@@ -38,6 +38,7 @@ R_GATHER_IN_MOD_QUERY = "jx-gather-in-mod-query"
 R_COLLECTIVE_COUNT = "jx-collective-count"
 R_WIRE_ACCOUNTING = "jx-wire-accounting"
 R_CALLBACK = "jx-callback"
+R_CODEC_COUNT = "jx-codec-count"
 R_RETRACE = "jx-retrace"  # emitted by the audit harness (two-trace hash)
 
 ALL_RULE_IDS = (
@@ -48,8 +49,15 @@ ALL_RULE_IDS = (
     R_COLLECTIVE_COUNT,
     R_WIRE_ACCOUNTING,
     R_CALLBACK,
+    R_CODEC_COUNT,
     R_RETRACE,
 )
+
+# sparsifier-selection primitives: every TensorCodec encode lowers its
+# top-k selection to exactly one of these, so their static eqn count is the
+# codec-invocation count of the traced exchange (the O(leaves) vs
+# O(buckets) claim, checked structurally)
+_SELECT_PRIMS = ("top_k", "approx_top_k")
 
 # collectives the inventory tracks (jax primitive names as they appear in
 # jaxprs); anything else moving data across the mesh axis would be a new
@@ -108,6 +116,9 @@ class AuditContext:
     wire_mode: Optional[str] = None  # 'allgather' | 'ring'
     expected_wire_bytes: Optional[int] = None
     num_workers: Optional[int] = None
+    # exact static count of sparsifier-selection eqns (top_k/approx_top_k):
+    # O(leaves) on the per-tensor path, O(buckets) on the bucketed path
+    expect_codec_invocations: Optional[int] = None
 
 
 # ---------------------------------------------------------------------- #
@@ -396,6 +407,30 @@ def rule_callback_whitelist(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
     ]
 
 
+def rule_codec_invocations(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """Pin the codec-invocation count of the exchange: each TensorCodec
+    encode runs exactly one top-k selection (sparse.topk lowers to one
+    top_k eqn; approx mode to one approx_top_k), so the per-tensor fused
+    path must show exactly L selections and the bucketed path exactly C —
+    the O(leaves) → O(buckets) encode claim, checked on the trace."""
+    if ctx.expect_codec_invocations is None:
+        return []
+    got = sum(
+        1 for eqn in walk_eqns(jaxpr) if eqn.primitive.name in _SELECT_PRIMS
+    )
+    if got == ctx.expect_codec_invocations:
+        return []
+    return [
+        Violation(
+            R_CODEC_COUNT,
+            ctx.label,
+            f"{got} sparsifier-selection eqn(s) (top_k/approx_top_k) but the "
+            f"trace contracts exactly {ctx.expect_codec_invocations} codec "
+            "invocation(s)",
+        )
+    ]
+
+
 JAXPR_RULES = (
     rule_no_f64,
     rule_static_shapes,
@@ -404,6 +439,7 @@ JAXPR_RULES = (
     rule_collective_inventory,
     rule_wire_accounting,
     rule_callback_whitelist,
+    rule_codec_invocations,
 )
 
 

@@ -103,6 +103,71 @@ class PayloadLayout:
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
 
+def decode_gathered_loop(
+    gathered, num_workers, decode_row, out_shapes, *, axis_name: str, need_own: bool
+):
+    """Sequential fori_loop over gathered workers (the original shape):
+    O(W·d) serial decode on the critical path, but only ONE dense
+    accumulator lives at a time. `decode_row` maps one worker's uint8 row
+    to a tuple of f32 arrays shaped like `out_shapes`; the own-row decode
+    (residual error-feedback) is folded into the same loop with a select
+    at w == my_index, so the decode program is traced once. Shared by the
+    whole-pytree fused path and the per-bucket decodes (comm_bucket.py)."""
+    widx = jax.lax.axis_index(axis_name)
+    acc0 = tuple(jnp.zeros(s, jnp.float32) for s in out_shapes)
+    own0 = acc0 if need_own else ()
+
+    def body(w, carry):
+        acc, own = carry
+        row = jax.lax.dynamic_index_in_dim(gathered, w, keepdims=False)
+        decs = decode_row(row)
+        new_acc = tuple(a + dec for a, dec in zip(acc, decs))
+        new_own = (
+            tuple(jnp.where(w == widx, dec, o) for dec, o in zip(decs, own))
+            if need_own
+            else ()
+        )
+        return new_acc, new_own
+
+    return jax.lax.fori_loop(0, num_workers, body, (acc0, own0))
+
+
+def decode_gathered_vmap(
+    gathered,
+    num_workers,
+    decode_row,
+    out_shapes,
+    *,
+    axis_name: str,
+    need_own: bool,
+    decode_batch: int,
+):
+    """Batched decode: the [W, B] gathered buffer is decoded in static
+    groups of `decode_batch` rows under jax.vmap — one wide kernel per
+    group (W/decode_batch launches instead of W sequential programs), with
+    peak memory bounded at decode_batch dense tensors per output. The
+    own-row decode is recovered by a masked sum over each group's rows
+    (adding exact zeros), so the decode program is still traced once
+    (vmapped), never a second unbatched time."""
+    W = int(num_workers)
+    G = max(1, min(int(decode_batch), W))
+    widx = jax.lax.axis_index(axis_name)
+    vdec = jax.vmap(decode_row)
+    acc = tuple(jnp.zeros(s, jnp.float32) for s in out_shapes)
+    own = acc if need_own else ()
+    for g0 in range(0, W, G):
+        g1 = min(g0 + G, W)
+        decs = vdec(jax.lax.slice_in_dim(gathered, g0, g1))  # [g, ...] each
+        acc = tuple(a + d.sum(axis=0) for a, d in zip(acc, decs))
+        if need_own:
+            mine = jnp.arange(g0, g1) == widx  # [g] one-hot or all-false
+            own = tuple(
+                o + (d * mine.reshape((-1,) + (1,) * (d.ndim - 1))).sum(axis=0)
+                for o, d in zip(own, decs)
+            )
+    return acc, own
+
+
 class GradientExchanger:
     """Compress -> all_gather -> decompress -> aggregate, per gradient tensor.
 
@@ -150,29 +215,78 @@ class GradientExchanger:
             )
         leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
         self.names = [_leaf_name(path) for path, _ in leaves]
-        self.codecs: Dict[str, TensorCodec] = {
-            name: TensorCodec(leaf.shape, cfg, name=name)
-            for name, (path, leaf) in zip(self.names, leaves)
-        }
         self._grad_dtypes = {
             name: jnp.dtype(leaf.dtype) for name, (path, leaf) in zip(self.names, leaves)
         }
+        self.codecs: Dict[str, TensorCodec] = {}
+        self._bucketed = None
         self._layouts: Optional[Dict[str, PayloadLayout]] = None
         self._offsets: Dict[str, int] = {}
         self._fused_nbytes = 0
-        if cfg.fused and cfg.communicator == "allgather":
-            self._layouts = {}
-            for name in self.names:
-                codec = self.codecs[name]
-                g_sds = jax.ShapeDtypeStruct(codec.shape, self._grad_dtypes[name])
-                payload_sds = jax.eval_shape(
-                    lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)),
-                    g_sds,
+        if cfg.bucket_bytes is not None:
+            if not (cfg.fused and cfg.communicator == "allgather"):
+                raise ValueError(
+                    "bucket_bytes partitions the FUSED allgather exchange and "
+                    "would be silently ignored here "
+                    f"(communicator={cfg.communicator!r}, fused={cfg.fused}) — "
+                    "use fused=True with communicator='allgather', or "
+                    "bucket_bytes=None"
                 )
-                self._layouts[name] = PayloadLayout(payload_sds)
-                self._offsets[name] = self._fused_nbytes
-                self._fused_nbytes += self._layouts[name].nbytes
-        if cfg.decode_strategy != "loop" and self._layouts is None:
+            if cfg.decode_strategy == "ring":
+                raise ValueError(
+                    "decode_strategy='ring' already pipelines transfer against "
+                    "decode over ppermute hops; combining it with bucket_bytes "
+                    "would nest two pipelines and the bucketing would be "
+                    "silently ignored — use decode_strategy='loop' or 'vmap' "
+                    "with bucket_bytes, or ring without it"
+                )
+            if cfg.deepreduce is None and cfg.compressor == "none":
+                raise ValueError(
+                    "bucket_bytes only affects the compressed allgather path; "
+                    "the dense baseline (deepreduce=None, compressor='none') "
+                    "is a psum and would silently ignore it — set "
+                    "bucket_bytes=None for dense runs"
+                )
+            if cfg.layer_pattern is not None:
+                raise ValueError(
+                    "layer_pattern excludes leaves BY NAME from compression, "
+                    "but fused buckets dissolve leaf identity (one codec spans "
+                    "many leaves) so the pattern would be silently ignored — "
+                    "use layer_pattern=None with bucket_bytes, or per-tensor "
+                    "codecs with layer_pattern"
+                )
+            # deferred import: comm_bucket imports PayloadLayout and the
+            # decode helpers from this module (same idiom as qar/sparse_rs)
+            from deepreduce_tpu.comm_bucket import BucketedExchanger
+
+            self._bucketed = BucketedExchanger(
+                self.names,
+                [leaf.shape for _, leaf in leaves],
+                cfg,
+                axis_name=axis_name,
+            )
+        else:
+            self.codecs = {
+                name: TensorCodec(leaf.shape, cfg, name=name)
+                for name, (path, leaf) in zip(self.names, leaves)
+            }
+            if cfg.fused and cfg.communicator == "allgather":
+                self._layouts = {}
+                for name in self.names:
+                    codec = self.codecs[name]
+                    g_sds = jax.ShapeDtypeStruct(codec.shape, self._grad_dtypes[name])
+                    payload_sds = jax.eval_shape(
+                        lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)),
+                        g_sds,
+                    )
+                    self._layouts[name] = PayloadLayout(payload_sds)
+                    self._offsets[name] = self._fused_nbytes
+                    self._fused_nbytes += self._layouts[name].nbytes
+        if (
+            cfg.decode_strategy != "loop"
+            and self._layouts is None
+            and self._bucketed is None
+        ):
             raise ValueError(
                 f"decode_strategy={cfg.decode_strategy!r} restructures the "
                 "FUSED allgather decode and would be silently ignored here "
@@ -180,6 +294,16 @@ class GradientExchanger:
                 "use fused=True with communicator='allgather', or "
                 "decode_strategy='loop'"
             )
+
+    @property
+    def num_buckets(self) -> int:
+        """Bucket count C of the bucketed exchange; 0 when unbucketed."""
+        return self._bucketed.num_buckets if self._bucketed is not None else 0
+
+    @property
+    def bucket_specs(self):
+        """The static BucketSpec partition (empty tuple when unbucketed)."""
+        return self._bucketed.specs if self._bucketed is not None else ()
 
     # ------------------------------------------------------------------ #
 
@@ -246,32 +370,43 @@ class GradientExchanger:
         if key is None:
             key = jax.random.PRNGKey(cfg.seed)
         worker_key = jax.random.fold_in(key, widx)
-        keys = self._keys(worker_key, step)
 
         compensated = grads
         if state is not None:
             compensated = memory.compensate(grads, state, beta=cfg.beta, gamma=cfg.gamma)
 
         flat_grads = dict(zip(self.names, jax.tree_util.tree_leaves(compensated)))
-
-        payloads = {}
-        stats_per = {}
-        with spans.span("exchange/encode"):
-            for name in self.names:
-                payloads[name] = self.codecs[name].encode(
-                    flat_grads[name], step=step, key=keys[name]
-                )
-                stats_per[name] = self.codecs[name].wire_stats(payloads[name])
-
         need_own = state is not None
-        if self._layouts is not None:
-            agg_leaves, own_leaves = self._exchange_fused(
-                payloads, num_workers, step, need_own=need_own
+
+        if self._bucketed is not None:
+            agg_leaves, own_leaves, stats_per, payloads = self._bucketed.run(
+                flat_grads, num_workers, step, worker_key, need_own=need_own
             )
+            codecs = self._bucketed.codecs
+            if collect is not None:
+                collect["bucket_saturated"] = self._bucketed.saturation_vector(
+                    stats_per
+                )
         else:
-            agg_leaves, own_leaves = self._exchange_per_tensor(
-                payloads, num_workers, step, need_own=need_own
-            )
+            keys = self._keys(worker_key, step)
+            codecs = self.codecs
+            payloads = {}
+            stats_per = {}
+            with spans.span("exchange/encode"):
+                for name in self.names:
+                    payloads[name] = self.codecs[name].encode(
+                        flat_grads[name], step=step, key=keys[name]
+                    )
+                    stats_per[name] = self.codecs[name].wire_stats(payloads[name])
+
+            if self._layouts is not None:
+                agg_leaves, own_leaves = self._exchange_fused(
+                    payloads, num_workers, step, need_own=need_own
+                )
+            else:
+                agg_leaves, own_leaves = self._exchange_per_tensor(
+                    payloads, num_workers, step, need_own=need_own
+                )
 
         if collect is not None:
             # measured bloom FPR inputs: the codec queries its own payload's
@@ -281,8 +416,8 @@ class GradientExchanger:
             # exceeds nsel regardless of how many false positives fired
             fp_c = jnp.zeros((), jnp.float32)
             fp_u = jnp.zeros((), jnp.float32)
-            for name in self.names:
-                stats = self.codecs[name].fp_stats(payloads[name])
+            for name, codec in codecs.items():
+                stats = codec.fp_stats(payloads[name])
                 if stats is None:
                     continue
                 fp_c = fp_c + stats[0]
@@ -402,58 +537,27 @@ class GradientExchanger:
     def _decode_gathered_loop(
         self, gathered, num_workers, step, *, need_own: bool
     ) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...]]:
-        """Sequential fori_loop over gathered workers (the original shape):
-        O(W·d) serial decode on the critical path, but only ONE dense
-        accumulator lives at a time."""
-        widx = jax.lax.axis_index(self.axis_name)
-        acc0 = tuple(
-            jnp.zeros(self.codecs[n].shape, jnp.float32) for n in self.names
+        return decode_gathered_loop(
+            gathered,
+            num_workers,
+            lambda row: self._decode_fused_row(row, step),
+            tuple(self.codecs[n].shape for n in self.names),
+            axis_name=self.axis_name,
+            need_own=need_own,
         )
-        own0 = acc0 if need_own else ()
-
-        def body(w, carry):
-            acc, own = carry
-            row = jax.lax.dynamic_index_in_dim(gathered, w, keepdims=False)
-            decs = self._decode_fused_row(row, step)
-            new_acc = tuple(a + dec for a, dec in zip(acc, decs))
-            new_own = (
-                tuple(jnp.where(w == widx, dec, o) for dec, o in zip(decs, own))
-                if need_own
-                else ()
-            )
-            return new_acc, new_own
-
-        return jax.lax.fori_loop(0, num_workers, body, (acc0, own0))
 
     def _decode_gathered_vmap(
         self, gathered, num_workers, step, *, need_own: bool
     ) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...]]:
-        """Batched decode: the [W, B] gathered buffer is decoded in static
-        groups of cfg.decode_batch rows under jax.vmap — one wide kernel per
-        group (W/decode_batch launches instead of W sequential programs),
-        with peak memory bounded at decode_batch dense tensors per leaf.
-        The own-payload decode is recovered by a masked sum over each
-        group's rows (adding exact zeros), so the decode program is still
-        traced once (vmapped), never a second unbatched time."""
-        W = int(num_workers)
-        G = max(1, min(int(self.cfg.decode_batch), W))
-        widx = jax.lax.axis_index(self.axis_name)
-        vdec = jax.vmap(lambda row: self._decode_fused_row(row, step))
-        acc = tuple(
-            jnp.zeros(self.codecs[n].shape, jnp.float32) for n in self.names
+        return decode_gathered_vmap(
+            gathered,
+            num_workers,
+            lambda row: self._decode_fused_row(row, step),
+            tuple(self.codecs[n].shape for n in self.names),
+            axis_name=self.axis_name,
+            need_own=need_own,
+            decode_batch=self.cfg.decode_batch,
         )
-        own = acc if need_own else ()
-        for g0 in range(0, W, G):
-            g1 = min(g0 + G, W)
-            decs = vdec(jax.lax.slice_in_dim(gathered, g0, g1))  # [g, ...] each
-            acc = tuple(a + d.sum(axis=0) for a, d in zip(acc, decs))
-            if need_own:
-                mine = jnp.arange(g0, g1) == widx  # [g] one-hot or all-false
-                own = tuple(
-                    o + (d * mine.reshape((-1,) + (1,) * (d.ndim - 1))).sum(axis=0)
-                    for o, d in zip(own, decs)
-                )
-        return acc, own
 
     def _exchange_sparse_rs(
         self, grads: Any, state: Any, *, step: jax.Array, key: Optional[jax.Array]
@@ -580,6 +684,11 @@ class GradientExchanger:
                 d, self.cfg.compress_ratio, W, self.cfg.rs_out_headroom
             )
             return (W * b + k2) * 8  # f32 value + i32 index per entry
+        if self._bucketed is not None:
+            # sum of the per-bucket PayloadLayout sizes — exactly what the C
+            # bucketed all_gather operands carry (jx-wire-accounting checks
+            # this equality against the traced jaxpr)
+            return self._bucketed.payload_nbytes
         total = 0
         flat = dict(zip(self.names, jax.tree_util.tree_leaves(grads_like)))
         for name, codec in self.codecs.items():

@@ -1,0 +1,329 @@
+"""Bucketed tensor-fusion exchange: one codec + one collective per BUCKET.
+
+The per-tensor fused path (comm.py) builds one TensorCodec, one top-k, and
+one payload per gradient leaf, then ships everything in a single bulk
+all_gather. On many-leaf models (LSTM gate stacks, MobileNet's dozens of
+tiny BN/bias tensors) the encode side pays O(leaves) fixed codec cost and
+the one bulk collective serializes the whole transfer ahead of the decode
+tail.
+
+This module trades both costs down:
+
+* `partition_buckets` splits the pytree into size-balanced buckets of at
+  most ``cfg.bucket_bytes`` dense f32 bytes. Leaves too big for a bucket
+  stay SOLO (and keep their leaf name, so their codec/PRNG contract is
+  bit-identical to the per-tensor path); the small leaves are packed
+  first-fit-decreasing into fused buckets and concatenated into one
+  contiguous f32 super-tensor each.
+* `BucketedExchanger` runs ONE TensorCodec per bucket — sparsifier +
+  index/value codec cost drops from O(leaves) to O(buckets) — with the
+  bucket's slot budget set to the SUM of its member leaves' per-tensor
+  budgets (`sparse.bucket_num_slots`), so bucketing never changes the
+  total wire budget.
+* One `all_gather` per bucket, software-pipelined in trace order: the
+  collective for bucket b+1 is dispatched BEFORE the decode of bucket b
+  (the SparCML streaming shape), so XLA can overlap the next transfer
+  with the current decode.
+
+Slicing a bucket's aggregate back into leaf shapes is static offsets
+(`split_bucket`), so residual error-feedback, WireStats accounting, and
+the deterministic policy contract carry over unchanged. The per-bucket
+wire format reuses `PayloadLayout`, and the per-bucket decode reuses the
+shared `decode_gathered_loop` / `decode_gathered_vmap` machinery.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu.comm import (
+    PayloadLayout,
+    decode_gathered_loop,
+    decode_gathered_vmap,
+)
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.metrics import WireStats, payload_device_bytes
+from deepreduce_tpu.sparse import bucket_num_slots, per_tensor_key
+from deepreduce_tpu.telemetry import spans
+from deepreduce_tpu.wrappers import TensorCodec
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """One bucket of the partition: which leaves it fuses (in concat
+    order), their flat element counts, and each leaf's static offset into
+    the bucket's f32 super-tensor. ``solo`` buckets hold exactly one leaf
+    and are labelled by that leaf's name, so their codec name — and hence
+    their deterministic per-tensor PRNG key — matches the unbucketed
+    path exactly."""
+
+    label: str
+    names: Tuple[str, ...]
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    total: int
+    solo: bool
+
+
+def partition_buckets(
+    names: Sequence[str], sizes: Sequence[int], bucket_bytes: int
+) -> List[BucketSpec]:
+    """Deterministic size-balanced partition computed from (name, size)
+    pairs alone — every worker derives the identical bucket list from the
+    gradient shapes with no coordination.
+
+    Leaves whose dense f32 payload exceeds ``bucket_bytes`` become solo
+    buckets. The remaining small leaves are packed first-fit-decreasing
+    (ties broken by original leaf order) into fused buckets of at most
+    ``bucket_bytes``; a fused bucket that ends up holding a single leaf is
+    demoted to solo so it keeps the leaf's name. Within a fused bucket the
+    leaves are concatenated in original pytree order, and the bucket list
+    itself is ordered by each bucket's earliest member leaf.
+    """
+    if len(names) != len(sizes):
+        raise ValueError("names and sizes must align")
+    if len(set(names)) != len(names):
+        raise ValueError("duplicate leaf names")
+    cap = max(1, int(bucket_bytes) // 4)  # f32 elements per fused bucket
+    index = {n: i for i, n in enumerate(names)}
+
+    def _solo(i: int) -> BucketSpec:
+        return BucketSpec(
+            label=names[i],
+            names=(names[i],),
+            sizes=(int(sizes[i]),),
+            offsets=(0,),
+            total=int(sizes[i]),
+            solo=True,
+        )
+
+    small: List[int] = []
+    specs: List[BucketSpec] = []
+    for i, size in enumerate(sizes):
+        if int(size) <= 0:
+            raise ValueError(f"leaf {names[i]!r} has non-positive size {size}")
+        (specs if int(size) > cap else small).append(
+            _solo(i) if int(size) > cap else i
+        )
+
+    # First-fit-decreasing over the small leaves: visit by descending
+    # size (original order breaks ties), drop each into the first bin
+    # with room. Deterministic, and within ~22% of the optimal bin count.
+    bins: List[List[int]] = []
+    loads: List[int] = []
+    for i in sorted(small, key=lambda i: (-int(sizes[i]), i)):
+        size = int(sizes[i])
+        for b, load in enumerate(loads):
+            if load + size <= cap:
+                bins[b].append(i)
+                loads[b] += size
+                break
+        else:
+            bins.append([i])
+            loads.append(size)
+
+    fused_count = 0
+    for members in bins:
+        if len(members) == 1:
+            specs.append(_solo(members[0]))
+            continue
+        members = sorted(members)  # concat in original pytree order
+        label = f"bucket{fused_count}"
+        fused_count += 1
+        while label in index:  # collision with a literal leaf name
+            label += "_"
+        offsets, off = [], 0
+        for i in members:
+            offsets.append(off)
+            off += int(sizes[i])
+        specs.append(
+            BucketSpec(
+                label=label,
+                names=tuple(names[i] for i in members),
+                sizes=tuple(int(sizes[i]) for i in members),
+                offsets=tuple(offsets),
+                total=off,
+                solo=False,
+            )
+        )
+
+    specs.sort(key=lambda s: min(index[n] for n in s.names))
+    return specs
+
+
+class BucketedExchanger:
+    """Per-bucket encode → all_gather → decode, built by GradientExchanger
+    when ``cfg.bucket_bytes`` is set. Holds one TensorCodec and one
+    PayloadLayout per bucket; `run` performs the whole exchange on the
+    compensated flat-gradient dict and hands back f32 leaf dicts plus
+    per-bucket WireStats and payloads (for fp_stats / telemetry)."""
+
+    def __init__(
+        self,
+        names: Sequence[str],
+        shapes: Sequence[Tuple[int, ...]],
+        cfg: DeepReduceConfig,
+        *,
+        axis_name: str,
+    ):
+        self.cfg = cfg
+        self.axis_name = axis_name
+        self.leaf_shapes: Dict[str, Tuple[int, ...]] = {
+            n: tuple(int(x) for x in s) for n, s in zip(names, shapes)
+        }
+        sizes = [_numel(self.leaf_shapes[n]) for n in names]
+        self.specs: Tuple[BucketSpec, ...] = tuple(
+            partition_buckets(list(names), sizes, cfg.bucket_bytes)
+        )
+        self.codecs: Dict[str, TensorCodec] = {}
+        self.layouts: Dict[str, PayloadLayout] = {}
+        self.payload_nbytes = 0
+        for spec in self.specs:
+            # The bucket's slot budget is the SUM of its member leaves'
+            # per-tensor budgets, so fusing never changes the total wire
+            # budget (per-leaf rounding and the max(1, .) floor included).
+            codec = TensorCodec(
+                (spec.total,),
+                cfg,
+                name=spec.label,
+                slots=bucket_num_slots(spec.sizes, cfg.compress_ratio),
+            )
+            payload_sds = jax.eval_shape(
+                lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)),
+                jax.ShapeDtypeStruct((spec.total,), jnp.float32),
+            )
+            self.codecs[spec.label] = codec
+            self.layouts[spec.label] = PayloadLayout(payload_sds)
+            self.payload_nbytes += payload_device_bytes(payload_sds)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.specs)
+
+    def concat_bucket(self, flat_grads: Dict[str, jax.Array], spec: BucketSpec):
+        """Flatten + concatenate the bucket's member leaves (in spec.names
+        order) into its contiguous f32 super-tensor."""
+        parts = [
+            flat_grads[n].reshape(-1).astype(jnp.float32) for n in spec.names
+        ]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def split_bucket(self, spec: BucketSpec, dense: jax.Array):
+        """Static-offset slices of the bucket's dense f32 aggregate back to
+        member leaf shapes (the inverse of `concat_bucket`)."""
+        return {
+            n: jax.lax.slice_in_dim(dense, off, off + size).reshape(
+                self.leaf_shapes[n]
+            )
+            for n, size, off in zip(spec.names, spec.sizes, spec.offsets)
+        }
+
+    def _decode_bucket(self, spec, gathered, num_workers, step, *, need_own):
+        codec = self.codecs[spec.label]
+        layout = self.layouts[spec.label]
+
+        def decode_row(row):
+            return (
+                codec.decode(layout.unpack(row), step=step).astype(jnp.float32),
+            )
+
+        if self.cfg.decode_strategy == "vmap":
+            total, own = decode_gathered_vmap(
+                gathered,
+                num_workers,
+                decode_row,
+                ((spec.total,),),
+                axis_name=self.axis_name,
+                need_own=need_own,
+                decode_batch=self.cfg.decode_batch,
+            )
+        else:
+            total, own = decode_gathered_loop(
+                gathered,
+                num_workers,
+                decode_row,
+                ((spec.total,),),
+                axis_name=self.axis_name,
+                need_own=need_own,
+            )
+        return total[0], (own[0] if need_own else None)
+
+    def run(self, flat_grads, num_workers, step, worker_key, *, need_own: bool):
+        """Full bucketed exchange over the compensated flat-gradient dict.
+
+        Returns ``(agg_leaves, own_leaves, stats_per, payloads)`` where the
+        leaf dicts are keyed like ``flat_grads`` (f32, mean over workers /
+        this worker's decode) and stats/payloads are keyed by bucket label.
+        """
+        payloads: Dict[str, object] = {}
+        stats_per: Dict[str, WireStats] = {}
+        with spans.span("exchange/encode"):
+            for spec in self.specs:
+                codec = self.codecs[spec.label]
+                key = per_tensor_key(worker_key, spec.label, step)
+                payload = codec.encode(
+                    self.concat_bucket(flat_grads, spec), step=step, key=key
+                )
+                payloads[spec.label] = payload
+                stats_per[spec.label] = codec.wire_stats(payload)
+        with spans.span("exchange/pack"):
+            bufs = [self.layouts[s.label].pack(payloads[s.label]) for s in self.specs]
+
+        C = len(self.specs)
+        totals: List = [None] * C
+        owns: List = [None] * C
+
+        def decode_into(b, gathered):
+            with spans.span(f"exchange/bucket/{self.specs[b].label}"):
+                totals[b], owns[b] = self._decode_bucket(
+                    self.specs[b], gathered, num_workers, step, need_own=need_own
+                )
+
+        if self.cfg.bucket_pipeline and C > 0:
+            # Software pipeline in trace order (the comm_ring idiom): the
+            # all_gather for bucket b+1 is dispatched BEFORE bucket b's
+            # decode, so the next transfer overlaps the current decode.
+            with spans.span("exchange/allgather"):
+                nxt = jax.lax.all_gather(bufs[0], self.axis_name)
+            for b in range(C):
+                cur = nxt
+                if b + 1 < C:
+                    with spans.span("exchange/allgather"):
+                        nxt = jax.lax.all_gather(bufs[b + 1], self.axis_name)
+                decode_into(b, cur)
+        else:
+            with spans.span("exchange/allgather"):
+                gathered = [jax.lax.all_gather(buf, self.axis_name) for buf in bufs]
+            for b in range(C):
+                decode_into(b, gathered[b])
+
+        agg_leaves: Dict[str, jax.Array] = {}
+        own_leaves: Dict[str, jax.Array] = {}
+        for b, spec in enumerate(self.specs):
+            agg_leaves.update(self.split_bucket(spec, totals[b] / num_workers))
+            if need_own:
+                own_leaves.update(self.split_bucket(spec, owns[b]))
+        return agg_leaves, own_leaves, stats_per, payloads
+
+    def saturation_vector(self, stats_per: Dict[str, WireStats]) -> jax.Array:
+        """f32[C] per-bucket saturation flags in spec order — the telemetry
+        counter that keeps one overfull bucket visible next to the summed
+        WireStats total."""
+        if not self.specs:
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.stack(
+            [
+                jnp.asarray(stats_per[s.label].saturated, jnp.float32).reshape(())
+                for s in self.specs
+            ]
+        )
+
+
+def _numel(shape: Tuple[int, ...]) -> int:
+    n = 1
+    for x in shape:
+        n *= int(x)
+    return n

@@ -104,6 +104,21 @@ class DeepReduceConfig:
     # 'vmap' group size: workers decoded per batched kernel. Bounds the
     # W-way peak-memory blowup the sequential loop was avoiding.
     decode_batch: int = 4
+    # bucketed fused exchange (comm_bucket.py): partition the gradient
+    # pytree into size-balanced buckets of <= bucket_bytes dense f32 bytes
+    # (small leaves concatenated into one contiguous super-tensor per
+    # bucket, big leaves solo) and run ONE TensorCodec + ONE all_gather per
+    # BUCKET instead of per leaf — encode fixed cost drops from O(leaves)
+    # to O(buckets) on many-leaf models (StackOverflow LSTM, MobileNet's
+    # dozens of BN/bias tensors). None = per-leaf codecs (the default
+    # fused shape). Distinct from `bucket_size`, which is the QAR
+    # quantization bucket length in elements.
+    bucket_bytes: Optional[int] = None
+    # software-pipeline the per-bucket collectives: dispatch the all_gather
+    # for bucket b+1 before decoding bucket b, so XLA overlaps the next
+    # transfer with the current decode (the SparCML streaming shape).
+    # False = gather every bucket, then decode (barrier shape, for A/Bs).
+    bucket_pipeline: bool = True
     # small-tensor bypass (pytorch/deepreduce.py:68). None = the reference
     # default for the selected codec: 1000 (PyTorch generic gate), or 9000
     # when value='doubleexp' (tensorflow/deepreduce.py:396,426). An explicit
@@ -168,6 +183,11 @@ class DeepReduceConfig:
         if self.telemetry_every < 1:
             raise ValueError(
                 f"telemetry_every must be >= 1, got {self.telemetry_every}"
+            )
+        if self.bucket_bytes is not None and self.bucket_bytes < 4:
+            raise ValueError(
+                "bucket_bytes must be >= 4 (one f32 element) or None, got "
+                f"{self.bucket_bytes}"
             )
 
     @classmethod

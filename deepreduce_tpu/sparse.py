@@ -17,7 +17,7 @@ helpers derive per-tensor per-step keys so no two steps repeat.
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -104,12 +104,22 @@ def num_slots(dense_size: int, compress_ratio: float) -> int:
     return max(1, int(dense_size * compress_ratio))
 
 
+def bucket_num_slots(sizes, compress_ratio: float) -> int:
+    """Slot budget of a fused bucket: the SUM of its member leaves'
+    per-tensor budgets, not `num_slots(sum(sizes))`. Per-leaf rounding and
+    the max(1, .) floor are preserved, so bucketing a pytree never changes
+    the total wire budget the per-leaf codecs would have transmitted
+    (comm_bucket.py's budget contract)."""
+    return sum(num_slots(int(s), compress_ratio) for s in sizes)
+
+
 def topk(
     tensor: jax.Array,
     compress_ratio: float,
     *,
     sort_indices: bool = True,
     approx: bool = False,
+    k: Optional[int] = None,
 ) -> SparseGrad:
     """Top-k by magnitude. Indices ascending when `sort_indices` (the TF
     reference sorts, tensorflow/deepreduce.py:276).
@@ -118,9 +128,11 @@ def topk(
     (~4x faster at 25M elements, recall ~0.95). Missed elements are exactly
     what residual error-feedback re-injects next step, so recall<1 trades
     a little convergence speed for a lot of wall-clock; deterministic, so
-    the encode/decode contract is unaffected."""
+    the encode/decode contract is unaffected. An explicit `k` overrides the
+    ratio-derived budget (the bucketed exchange's summed per-leaf budget,
+    `bucket_num_slots`)."""
     flat = tensor.reshape(-1)
-    k = num_slots(flat.shape[0], compress_ratio)
+    k = num_slots(flat.shape[0], compress_ratio) if k is None else int(k)
     if approx and flat.shape[0] > 4 * k:
         _, idxs = jax.lax.approx_max_k(jnp.abs(flat), k, recall_target=0.95)
     else:
@@ -234,6 +246,7 @@ def topk_sampled(
     *,
     sample_size: int = 1 << 15,
     undershoot: float = 0.9,
+    k: Optional[int] = None,
 ) -> SparseGrad:
     """Sortless O(d) approximate top-k: sampled-quantile threshold + rank-
     inversion compaction (the Deep-Gradient-Compression selection shape;
@@ -251,11 +264,11 @@ def topk_sampled(
     falls back to exact selection via ``lax.cond``."""
     flat = tensor.reshape(-1)
     d = flat.shape[0]
-    k = num_slots(d, compress_ratio)
+    k = num_slots(d, compress_ratio) if k is None else int(k)
     if d <= max(4 * k, 2 * sample_size):
         # small tensors: the exact path is already cheap, and sampling error
         # would dominate
-        return topk(tensor, compress_ratio)
+        return topk(tensor, compress_ratio, k=k)
     t = sampled_kth_magnitude(flat, k, sample_size=sample_size, undershoot=undershoot)
 
     def sampled(flat):
@@ -290,7 +303,12 @@ def topk_sampled(
 
 
 def randomk(
-    tensor: jax.Array, compress_ratio: float, key: jax.Array, *, sort_indices: bool = True
+    tensor: jax.Array,
+    compress_ratio: float,
+    key: jax.Array,
+    *,
+    sort_indices: bool = True,
+    k: Optional[int] = None,
 ) -> SparseGrad:
     """Uniform random k of d without replacement, keyed per tensor per step
     (fixing the reference's fixed-seed quirk, pytorch/deepreduce.py:484-488).
@@ -300,7 +318,7 @@ def randomk(
     """
     flat = tensor.reshape(-1)
     d = flat.shape[0]
-    k = num_slots(d, compress_ratio)
+    k = num_slots(d, compress_ratio) if k is None else int(k)
     priorities = jax.random.uniform(key, (d,))
     _, idxs = jax.lax.top_k(priorities, k)
     if sort_indices:
@@ -363,7 +381,13 @@ def threshold_overflow(
     return jnp.maximum(n_above - k, 0)
 
 
-def threshold(tensor: jax.Array, threshold_val: float, *, budget_ratio: float = 1.0) -> SparseGrad:
+def threshold(
+    tensor: jax.Array,
+    threshold_val: float,
+    *,
+    budget_ratio: float = 1.0,
+    k: Optional[int] = None,
+) -> SparseGrad:
     """Keep |g| >= max(threshold, needed-to-fit-budget).
 
     The reference clamps the threshold down to the max |g| so at least one
@@ -377,7 +401,7 @@ def threshold(tensor: jax.Array, threshold_val: float, *, budget_ratio: float = 
     """
     flat = tensor.reshape(-1)
     d = flat.shape[0]
-    k = num_slots(d, budget_ratio)
+    k = num_slots(d, budget_ratio) if k is None else int(k)
     mags = jnp.abs(flat)
     thr = jnp.minimum(jnp.asarray(threshold_val, flat.dtype), jnp.max(mags))
     vals_top, idxs = jax.lax.top_k(mags, k)

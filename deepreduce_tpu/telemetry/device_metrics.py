@@ -45,11 +45,21 @@ class MetricAccumulators:
     err_cos: jax.Array        # Σ per-step cos(agg, dense_mean)
     fp_count: jax.Array       # Σ bloom false positives (decoded-but-not-selected)
     fp_universe: jax.Array    # Σ not-selected universe size (FPR denominator)
+    # Σ per-BUCKET saturation counts, f32[C] in bucket-spec order for the
+    # bucketed exchange (f32[0] when unbucketed) — keeps one chronically
+    # overfull bucket visible next to the summed `saturated` total
+    bucket_saturated: jax.Array
 
     @classmethod
-    def zeros(cls) -> "MetricAccumulators":
-        z = jnp.zeros((), jnp.float32)
-        return cls(*(z,) * len(dataclasses.fields(cls)))
+    def zeros(cls, num_buckets: int = 0) -> "MetricAccumulators":
+        # one FRESH buffer per field: the accumulator is donated to the jitted
+        # step (train.Trainer._build), and donating one shared zeros() buffer
+        # for every field is a donate-twice XLA runtime error
+        scalars = tuple(
+            jnp.zeros((), jnp.float32)
+            for _ in range(len(dataclasses.fields(cls)) - 1)
+        )
+        return cls(*scalars, jnp.zeros((int(num_buckets),), jnp.float32))
 
     def accumulate(
         self,
@@ -60,6 +70,7 @@ class MetricAccumulators:
         err_cos=0.0,
         fp_count=0.0,
         fp_universe=0.0,
+        bucket_saturated=0.0,
     ) -> "MetricAccumulators":
         f = lambda x: jnp.asarray(x, jnp.float32)
         return MetricAccumulators(
@@ -73,6 +84,10 @@ class MetricAccumulators:
             err_cos=self.err_cos + f(err_cos),
             fp_count=self.fp_count + f(fp_count),
             fp_universe=self.fp_universe + f(fp_universe),
+            # broadcasts: [C] + [C] per-step vector, or [C] + 0.0 when the
+            # caller has nothing to report this step (and [0] + 0.0 when
+            # unbucketed — a no-op on the empty vector)
+            bucket_saturated=self.bucket_saturated + f(bucket_saturated),
         )
 
     # ------------------------------------------------------------------ #
@@ -93,10 +108,17 @@ class MetricAccumulators:
         vals = {
             f.name: float(np.asarray(getattr(self, f.name)))
             for f in dataclasses.fields(self)
+            if f.name != "bucket_saturated"  # vector-valued, handled below
         }
         steps = max(vals["steps"], 1.0)
         dense = max(vals["dense_bits"], _EPS)
-        return {
+        bucket_sat = np.asarray(self.bucket_saturated, np.float32).reshape(-1)
+        out = {}
+        if bucket_sat.size:
+            out["bucket_saturated_per_step"] = [
+                float(v) / steps for v in bucket_sat
+            ]
+        return out | {
             "steps": vals["steps"],
             "cumulative_total_bits": vals["index_bits"] + vals["value_bits"],
             "rel_volume": (vals["index_bits"] + vals["value_bits"]) / dense,

@@ -136,6 +136,9 @@ def make_worker_step(
             residual_l2 = jax.lax.pmean(jnp.sqrt(res_sq), axis)
         else:
             residual_l2 = jnp.zeros((), jnp.float32)
+        # per-bucket saturation counts, f32[C] (only present when the
+        # bucketed exchange ran); summed over workers like `saturated`
+        bucket_sat = collect.get("bucket_saturated")
         new_acc = acc.accumulate(
             wire_mean,
             residual_l2=residual_l2,
@@ -143,6 +146,9 @@ def make_worker_step(
             err_cos=err_cos,
             fp_count=jax.lax.psum(collect["fp_count"], axis),
             fp_universe=jax.lax.psum(collect["fp_universe"], axis),
+            bucket_saturated=(
+                jax.lax.psum(bucket_sat, axis) if bucket_sat is not None else 0.0
+            ),
         )
         return new_state, loss, wire_mean, new_acc
 
@@ -259,7 +265,14 @@ class Trainer:
             check_vma=False,
         )
         self._raw_step_fn = fn  # unjitted, for make_jaxpr-based audits
-        return jax.jit(fn)
+        # donate the step carries (replicated state, worker-local residuals,
+        # and the telemetry accumulator) so XLA updates them in place instead
+        # of doubling peak HBM across params + opt_state; batch and key are
+        # consumed fresh each step and stay undonated. Donation is a
+        # jit-level buffer annotation — the traced program (and therefore
+        # the telemetry retrace-hash contract on _raw_step_fn) is unchanged.
+        donate = (0, 1, 4) if telemetry else (0, 1)
+        return jax.jit(fn, donate_argnums=donate)
 
     def step(self, state: TrainState, batch, key: jax.Array):
         """One synchronous DP step. batch's leading dim is the global batch,
@@ -270,7 +283,9 @@ class Trainer:
         state_nores = dataclasses.replace(state, residuals=None)
         if self.cfg.telemetry:
             if self._telemetry_acc is None:
-                self._telemetry_acc = MetricAccumulators.zeros()
+                self._telemetry_acc = MetricAccumulators.zeros(
+                    num_buckets=self.exchanger.num_buckets
+                )
             new_nores, new_res, loss, wire, self._telemetry_acc = self._step_fn(
                 state_nores, state.residuals, batch, key, self._telemetry_acc
             )

@@ -81,7 +81,17 @@ class TensorCodec:
     reference's wrapper instance installed as `grc.compressor`
     (pytorch/deepreduce.py:45-46)."""
 
-    def __init__(self, shape: Tuple[int, ...], cfg: DeepReduceConfig, name: str = ""):
+    def __init__(
+        self,
+        shape: Tuple[int, ...],
+        cfg: DeepReduceConfig,
+        name: str = "",
+        slots: Optional[int] = None,
+    ):
+        """`slots` overrides the k = num_slots(d, ratio) budget — the
+        bucketed exchange (comm_bucket.py) passes the SUM of its member
+        leaves' per-tensor budgets so fusing never changes the total wire
+        budget. Ignored for compressor='none' (k is the full tensor)."""
         self.shape = tuple(int(s) for s in shape)
         self.cfg = cfg
         self.name = name
@@ -119,10 +129,14 @@ class TensorCodec:
         )
         if cfg.compressor == "none":
             self.k = self.d
-        elif cfg.compressor == "threshold":
-            self.k = sparse.num_slots(self.d, cfg.compress_ratio)
+        elif slots is not None:
+            self.k = int(slots)
         else:
             self.k = sparse.num_slots(self.d, cfg.compress_ratio)
+        if self.k > self.d:
+            raise ValueError(
+                f"slot budget k={self.k} exceeds the tensor size d={self.d}"
+            )
 
         if cfg.deepreduce == "both" and cfg.index == "bloom_native":
             raise ValueError(
@@ -194,21 +208,29 @@ class TensorCodec:
         cfg = self.cfg
         if self.pattern_excluded:
             return sparse.none_sparsifier(tensor)
+        # k=self.k keeps the sparsifier's selection budget and the codec's
+        # payload budget the same value when a `slots` override is in play
+        # (identical to the ratio-derived k otherwise)
         if cfg.compressor == "topk":
-            return sparse.topk(tensor, cfg.compress_ratio, approx=cfg.approx_topk)
+            return sparse.topk(
+                tensor, cfg.compress_ratio, approx=cfg.approx_topk, k=self.k
+            )
         if cfg.compressor == "topk_sampled":
             return sparse.topk_sampled(
                 tensor,
                 cfg.compress_ratio,
                 sample_size=cfg.topk_sample_size,
                 undershoot=cfg.topk_undershoot,
+                k=self.k,
             )
         if cfg.compressor == "randomk":
             if key is None:
                 raise ValueError("randomk sparsifier needs a PRNG key")
-            return sparse.randomk(tensor, cfg.compress_ratio, key)
+            return sparse.randomk(tensor, cfg.compress_ratio, key, k=self.k)
         if cfg.compressor == "threshold":
-            return sparse.threshold(tensor, cfg.threshold_val, budget_ratio=cfg.compress_ratio)
+            return sparse.threshold(
+                tensor, cfg.threshold_val, budget_ratio=cfg.compress_ratio, k=self.k
+            )
         if cfg.compressor == "none":
             return sparse.none_sparsifier(tensor)
         raise ValueError(f"unknown sparsifier {cfg.compressor!r}")

@@ -44,7 +44,7 @@ def test_quick_jaxpr_audit_clean():
     labels = {r.label for r in records}
     assert "query:bloom-mod" in labels
     assert {"exchange:fused-loop", "exchange:fused-vmap",
-            "exchange:fused-ring"} <= labels
+            "exchange:fused-ring", "exchange:bucketed-loop"} <= labels
 
 
 def test_mod_query_is_gather_free():
@@ -233,6 +233,27 @@ def test_wire_accounting_mismatch_caught():
     bad = AuditContext(label="fixture:wire-bad", wire_mode="allgather",
                        expected_wire_bytes=4 * d + 1)
     _only(run_rules(closed, bad), rules.R_WIRE_ACCOUNTING)
+
+
+def test_codec_invocation_count_caught():
+    """A 'bucketed' exchange that runs a per-leaf top-k breaks the
+    O(buckets) codec contract — the count of selection eqns is the proxy."""
+    k = 16
+
+    def per_leaf_select(a, b):
+        va, _ = jax.lax.top_k(a, k)
+        vb, _ = jax.lax.top_k(b, k)
+        return va.sum() + vb.sum()
+
+    closed = jax.make_jaxpr(per_leaf_select)(
+        jax.ShapeDtypeStruct((256,), jnp.float32),
+        jax.ShapeDtypeStruct((128,), jnp.float32),
+    )
+    good = AuditContext(label="fixture:codec-ok", expect_codec_invocations=2)
+    assert run_rules(closed, good) == []
+    bad = AuditContext(label="fixture:codec-bad", expect_codec_invocations=1)
+    v = _only(run_rules(closed, bad), rules.R_CODEC_COUNT)
+    assert "2" in v.detail
 
 
 def test_retrace_hash_stable():

@@ -1,0 +1,382 @@
+"""Bucketed tensor-fusion exchange on the virtual 8-worker CPU mesh.
+
+The bucketed mode (cfg.bucket_bytes, comm_bucket.py) partitions the
+gradient pytree into size-balanced buckets, runs ONE TensorCodec and one
+all_gather per bucket, and slices the aggregates back by static offsets.
+These tests pin its contracts:
+
+- solo buckets (big leaves, and any bucket holding exactly one leaf) reuse
+  the leaf's codec name, so their exchange is equal to the per-tensor
+  fused 'loop' path within f32 associativity — exactly, payload-for-
+  payload, even for stochastic codecs;
+- a fused multi-leaf bucket is equivalent to per-tensor-exchanging the
+  CONCATENATED super-tensor (the concat oracle) — selection scope moves
+  to the bucket, the wire slot budget does not;
+- the partition is deterministic from (name, size) alone, covers every
+  leaf exactly once, and never builds a fused bucket over budget;
+- `PayloadLayout` round-trips its edge cases (empty pytree, bool leaves,
+  single leaf);
+- pipelining and decode strategy are pure schedule choices: bucketed
+  loop / vmap / pipeline-off all land on identical results and wire bits;
+- the config validation surface refuses the combinations that would
+  silently ignore bucketing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from conftest import shared_mesh
+from deepreduce_tpu.comm import GradientExchanger, PayloadLayout
+from deepreduce_tpu.comm_bucket import partition_buckets
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.sparse import bucket_num_slots, num_slots
+from deepreduce_tpu.utils.compat import shard_map
+
+W, D = 8, 4096
+
+BLOOM_CFG = dict(
+    deepreduce="index", index="bloom", compress_ratio=0.02, fpr=0.01,
+    bloom_blocked="mod", policy="p0", min_compress_size=100,
+)
+QSGD_CFG = dict(
+    deepreduce="both", index="bloom", value="qsgd", policy="p0",
+    compress_ratio=0.05, fpr=0.05, bloom_blocked="mod", min_compress_size=100,
+)
+
+
+def _run(cfg, grads_w, step=0):
+    """Exchange a worker-stacked pytree (each leaf [W, ...]) on the shared
+    mesh; returns (agg pytree of np arrays, residual leaves or None, wire
+    bits, exchanger)."""
+    tmap = jax.tree_util.tree_map
+    n = jax.tree_util.tree_leaves(grads_w)[0].shape[0]
+    like = tmap(lambda g: jax.ShapeDtypeStruct(g.shape[1:], jnp.float32), grads_w)
+    ex = GradientExchanger(like, cfg, num_workers=n)
+    res0 = ex.init_state(tmap(lambda s: jnp.zeros(s.shape, s.dtype), like))
+    if res0 is not None:
+        res0 = tmap(lambda r: jnp.broadcast_to(r[None], (n,) + r.shape), res0)
+
+    def spmd(g, res):
+        if res is not None:
+            res = tmap(lambda r: r[0], res)
+        agg, new_res, stats = ex.exchange(tmap(lambda x: x[0], g), res, step=step)
+        if new_res is not None:
+            new_res = tmap(lambda r: r[None], new_res)
+        return tmap(lambda x: x[None], agg), new_res, stats.total_bits
+
+    res_spec = P() if res0 is None else P("data")
+    fn = shard_map(
+        spmd,
+        mesh=shared_mesh(n),
+        in_specs=(P("data"), res_spec),
+        out_specs=(P("data"), res_spec, P()),
+        check_vma=False,
+    )
+    agg, res, bits = jax.jit(fn)(tmap(jnp.asarray, grads_w), res0)
+    agg = tmap(np.asarray, agg)
+    res = None if res is None else tmap(np.asarray, res)
+    return agg, res, float(bits), ex
+
+
+def _grads(seed=0, n=W, d=D):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(n, d)) * rng.random((n, d)) ** 2).astype(np.float32)
+
+
+def _grads_tree(shapes, seed=0, n=W):
+    rng = np.random.default_rng(seed)
+    return {
+        name: (rng.normal(size=(n, d)) * rng.random((n, d)) ** 2).astype(
+            np.float32
+        )
+        for name, d in shapes.items()
+    }
+
+
+# --------------------------------------------------------------------- #
+# partition properties
+# --------------------------------------------------------------------- #
+
+CENSUS = {
+    "emb": 3000, "w1": 900, "w2": 700, "b1": 300, "b2": 150, "b3": 50,
+}
+
+
+def test_partition_covers_every_leaf_exactly_once_within_budget():
+    names, sizes = list(CENSUS), list(CENSUS.values())
+    specs = partition_buckets(names, sizes, bucket_bytes=4800)
+    placed = [n for s in specs for n in s.names]
+    assert sorted(placed) == sorted(names)  # exactly once, no leaf dropped
+    cap = 4800 // 4
+    for s in specs:
+        assert s.total == sum(s.sizes)
+        assert s.offsets == tuple(np.cumsum((0,) + s.sizes[:-1]).tolist())
+        if not s.solo:
+            assert len(s.names) > 1  # 1-member bins are demoted to solo
+            assert s.total <= cap    # fused buckets never over budget
+        else:
+            assert s.names == (s.label,)  # solo keeps the leaf's name
+
+
+def test_partition_deterministic_from_shapes_alone():
+    names, sizes = list(CENSUS), list(CENSUS.values())
+    a = partition_buckets(names, sizes, bucket_bytes=4800)
+    b = partition_buckets(names, sizes, bucket_bytes=4800)
+    assert a == b
+    # labels are unique even when a gradient leaf is literally named like
+    # a fused-bucket label (the collision guard appends underscores)
+    specs = partition_buckets(["bucket0", "x", "y"], [10, 20, 30], 4000)
+    labels = [s.label for s in specs]
+    assert len(set(labels)) == len(labels)
+
+
+def test_partition_big_leaves_stay_solo():
+    specs = partition_buckets(["big", "tiny"], [10_000, 8], bucket_bytes=1024)
+    by_label = {s.label: s for s in specs}
+    assert by_label["big"].solo and by_label["big"].total == 10_000
+    assert by_label["tiny"].solo  # 1-member bin demoted, keeps leaf name
+
+
+def test_bucket_budget_is_sum_of_member_budgets():
+    """Fusing never changes the total wire slot budget: the bucket codec's
+    k is the SUM of its member leaves' per-tensor budgets (rounding and
+    the max(1,.) floor preserved leaf-by-leaf)."""
+    ratio = 0.02
+    assert bucket_num_slots((900, 300), ratio) == num_slots(900, ratio) + num_slots(300, ratio)
+    # tiny leaves keep their max(1,.) floor inside a bucket
+    assert bucket_num_slots((10, 10, 10), ratio) == 3
+    like = {n: jax.ShapeDtypeStruct((d,), jnp.float32) for n, d in CENSUS.items()}
+    cfg_b = DeepReduceConfig(memory="none", bucket_bytes=4800, **BLOOM_CFG)
+    cfg_l = DeepReduceConfig(memory="none", **BLOOM_CFG)
+    ex_b = GradientExchanger(like, cfg_b, num_workers=W)
+    ex_l = GradientExchanger(like, cfg_l, num_workers=W)
+    k_bucketed = sum(c.k for c in ex_b._bucketed.codecs.values())
+    k_perleaf = sum(c.k for c in ex_l.codecs.values())
+    assert k_bucketed == k_perleaf
+
+
+# --------------------------------------------------------------------- #
+# equivalence: solo buckets == the per-tensor fused 'loop' path
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize(
+    "codec_cfg", [BLOOM_CFG, QSGD_CFG], ids=["bloom-index", "bloom-qsgd-both"]
+)
+@pytest.mark.parametrize("memory", ["none", "residual"])
+def test_single_leaf_bucketed_equals_fused_loop(codec_cfg, memory):
+    """A leaf too big for any bucket is a SOLO bucket labelled by the leaf
+    name, so its codec and per-tensor PRNG key are identical to the
+    unbucketed path — the aggregate, residual, and wire bits must match
+    exactly, stochastic value codec included."""
+    grads_w = _grads(seed=3)
+    cfg_b = DeepReduceConfig(
+        memory=memory, bucket_bytes=1024, **codec_cfg  # 1 KB << 16 KB leaf
+    )
+    cfg_l = DeepReduceConfig(memory=memory, decode_strategy="loop", **codec_cfg)
+    agg_b, res_b, bits_b, ex_b = _run(cfg_b, grads_w)
+    agg_l, res_l, bits_l, _ = _run(cfg_l, grads_w)
+    assert ex_b.num_buckets == 1 and ex_b.bucket_specs[0].solo
+    assert bits_b == bits_l  # identical payloads cross the wire
+    np.testing.assert_allclose(agg_b, agg_l, rtol=1e-5, atol=1e-6)
+    if memory == "residual":
+        np.testing.assert_allclose(res_b, res_l, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_bucket_matches_concat_oracle():
+    """A multi-leaf bucket must behave exactly like per-tensor-exchanging
+    the concatenated super-tensor: same selection, same payload budget,
+    same aggregate (sliced back by static offsets). Deterministic codec so
+    the differing codec names can't matter."""
+    shapes = {"a": 2800, "b": 1200, "c": 400}  # all divisible by 1/ratio
+    grads_w = _grads_tree(shapes, seed=11)
+    total = sum(shapes.values())
+    cfg_b = DeepReduceConfig(
+        memory="none", bucket_bytes=4 * total, **BLOOM_CFG
+    )
+    agg_b, _, bits_b, ex_b = _run(cfg_b, grads_w)
+    assert ex_b.num_buckets == 1 and not ex_b.bucket_specs[0].solo
+
+    # oracle: one concatenated leaf through the plain per-tensor fused path,
+    # concatenated in the bucket's member order
+    spec = ex_b.bucket_specs[0]
+    cat = np.concatenate([grads_w[n] for n in spec.names], axis=1)
+    cfg_l = DeepReduceConfig(memory="none", decode_strategy="loop", **BLOOM_CFG)
+    agg_cat, _, bits_cat, _ = _run(cfg_l, {"cat": cat})
+    assert bits_b == bits_cat
+    for name, size, off in zip(spec.names, spec.sizes, spec.offsets):
+        np.testing.assert_allclose(
+            agg_b[name], agg_cat["cat"][:, off : off + size],
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+@pytest.mark.parametrize("memory", ["none", "residual"])
+def test_bucketed_schedules_agree(memory):
+    """Pipelining and decode strategy are pure schedule choices — bucketed
+    loop / vmap / pipeline-off produce identical aggregates, residuals,
+    and wire bits on the multi-bucket census."""
+    grads_w = _grads_tree(CENSUS, seed=13)
+    variants = {
+        "loop": dict(decode_strategy="loop"),
+        "vmap": dict(decode_strategy="vmap", decode_batch=3),
+        "no-pipeline": dict(decode_strategy="loop", bucket_pipeline=False),
+    }
+    outs = {}
+    for vname, kw in variants.items():
+        cfg = DeepReduceConfig(
+            memory=memory, bucket_bytes=4800, **kw, **BLOOM_CFG
+        )
+        outs[vname] = _run(cfg, grads_w)
+    agg_l, res_l, bits_l, ex = outs["loop"]
+    assert ex.num_buckets == 3  # emb solo + two fused bins
+    for vname in ("vmap", "no-pipeline"):
+        agg_v, res_v, bits_v, _ = outs[vname]
+        assert bits_v == bits_l
+        for name in CENSUS:
+            np.testing.assert_allclose(
+                agg_v[name], agg_l[name], rtol=1e-5, atol=1e-6
+            )
+            if memory == "residual":
+                np.testing.assert_allclose(
+                    res_v[name], res_l[name], rtol=1e-5, atol=1e-6
+                )
+
+
+def test_bucketed_payload_bytes_matches_layouts():
+    """payload_bytes() is the sum of the per-bucket PayloadLayout sizes —
+    what the C all_gather operands actually carry (the wire-accounting
+    rule's ground truth)."""
+    like = {n: jax.ShapeDtypeStruct((d,), jnp.float32) for n, d in CENSUS.items()}
+    g = {n: jnp.zeros((d,), jnp.float32) for n, d in CENSUS.items()}
+    cfg = DeepReduceConfig(memory="none", bucket_bytes=4800, **BLOOM_CFG)
+    ex = GradientExchanger(like, cfg, num_workers=W)
+    assert ex.payload_bytes(g) == sum(
+        l.nbytes for l in ex._bucketed.layouts.values()
+    )
+
+
+# --------------------------------------------------------------------- #
+# PayloadLayout edge cases
+# --------------------------------------------------------------------- #
+
+
+def test_payload_layout_empty_pytree():
+    layout = PayloadLayout({})
+    assert layout.nbytes == 0
+    buf = layout.pack({})
+    assert buf.shape == (0,) and buf.dtype == jnp.uint8
+    assert layout.unpack(buf) == {}
+
+
+def test_payload_layout_bool_leaves_roundtrip():
+    payload = {
+        "mask": jnp.asarray(np.arange(13) % 3 == 0),
+        "vals": jnp.asarray(np.linspace(-2, 2, 5), jnp.float32),
+    }
+    layout = PayloadLayout(jax.eval_shape(lambda: payload))
+    buf = layout.pack(payload)
+    assert buf.dtype == jnp.uint8 and buf.shape == (13 + 20,)
+    out = layout.unpack(buf)
+    assert out["mask"].dtype == jnp.bool_
+    np.testing.assert_array_equal(out["mask"], payload["mask"])
+    np.testing.assert_array_equal(out["vals"], payload["vals"])
+
+
+def test_payload_layout_single_leaf_roundtrip():
+    payload = jnp.asarray(np.arange(7, dtype=np.uint8))
+    layout = PayloadLayout(jax.eval_shape(lambda: payload))
+    assert layout.nbytes == 7
+    np.testing.assert_array_equal(layout.unpack(layout.pack(payload)), payload)
+
+
+# --------------------------------------------------------------------- #
+# validation surface
+# --------------------------------------------------------------------- #
+
+
+def test_bucketed_config_validation():
+    with pytest.raises(ValueError, match="bucket_bytes"):
+        DeepReduceConfig(bucket_bytes=2)
+    like = jax.ShapeDtypeStruct((D,), jnp.float32)
+    with pytest.raises(ValueError, match="fused"):
+        GradientExchanger(
+            like, DeepReduceConfig(fused=False, bucket_bytes=4096, **BLOOM_CFG)
+        )
+    with pytest.raises(ValueError, match="ring"):
+        GradientExchanger(
+            like,
+            DeepReduceConfig(
+                decode_strategy="ring", bucket_bytes=4096, **BLOOM_CFG
+            ),
+        )
+    with pytest.raises(ValueError, match="dense"):
+        GradientExchanger(
+            like,
+            DeepReduceConfig(
+                compressor="none", deepreduce=None, memory="none",
+                bucket_bytes=4096,
+            ),
+        )
+    with pytest.raises(ValueError, match="layer_pattern"):
+        GradientExchanger(
+            like,
+            DeepReduceConfig(
+                layer_pattern="bias", bucket_bytes=4096, **BLOOM_CFG
+            ),
+        )
+
+
+# --------------------------------------------------------------------- #
+# telemetry plumbing
+# --------------------------------------------------------------------- #
+
+
+def test_bucket_saturation_collected_per_bucket():
+    """collect['bucket_saturated'] is an f32[C] vector in bucket-spec
+    order; with compress_ratio=1.0 every selection fills its budget, so
+    every bucket reports saturated."""
+    shapes = {"a": 300, "b": 200, "c": 2000}
+    tmap = jax.tree_util.tree_map
+    like = {n: jax.ShapeDtypeStruct((d,), jnp.float32) for n, d in shapes.items()}
+    cfg = DeepReduceConfig(
+        memory="none", bucket_bytes=4000, deepreduce="index", index="bloom",
+        compress_ratio=1.0, fpr=0.01, bloom_blocked="mod", policy="p0",
+        min_compress_size=100,
+    )
+    ex = GradientExchanger(like, cfg, num_workers=W)
+    grads_w = _grads_tree(shapes, seed=17)
+
+    def spmd(g):
+        collect = {}
+        agg, _, _ = ex.exchange(tmap(lambda x: x[0], g), None, collect=collect)
+        return collect["bucket_saturated"][None]
+
+    fn = shard_map(
+        spmd, mesh=shared_mesh(W), in_specs=(P("data"),),
+        out_specs=P("data"), check_vma=False,
+    )
+    sat = np.asarray(jax.jit(fn)(tmap(jnp.asarray, grads_w)))
+    assert sat.shape == (W, ex.num_buckets)
+    np.testing.assert_array_equal(sat, np.ones_like(sat))
+
+
+def test_metric_accumulators_bucket_vector():
+    from deepreduce_tpu.metrics import WireStats
+    from deepreduce_tpu.telemetry import MetricAccumulators
+
+    wire = WireStats(
+        index_bits=jnp.asarray(10.0), value_bits=jnp.asarray(20.0),
+        dense_bits=jnp.asarray(100.0), saturated=jnp.asarray(1.0),
+    )
+    acc = MetricAccumulators.zeros(num_buckets=3)
+    assert acc.bucket_saturated.shape == (3,)
+    acc = acc.accumulate(wire, bucket_saturated=jnp.asarray([1.0, 0.0, 1.0]))
+    acc = acc.accumulate(wire)  # a step with nothing to report broadcasts 0
+    summary = acc.summary()
+    assert summary["bucket_saturated_per_step"] == [0.5, 0.0, 0.5]
+    # unbucketed accumulators keep the scalar summary surface unchanged
+    assert "bucket_saturated_per_step" not in MetricAccumulators.zeros().summary()

@@ -83,6 +83,35 @@ def test_deepreduce_both_learns():
     assert float(wire.rel_volume()) < 0.2
 
 
+def test_step_donates_state_buffers():
+    """The jitted step donates its carries (params/opt_state inside the
+    state, and the worker-local residuals) so XLA updates them in place —
+    after a step, the PRIOR state's donated buffers must be consumed
+    (`is_deleted`), and the returned state's buffers must be live."""
+    cfg = DeepReduceConfig(
+        deepreduce="index", index="bloom", compress_ratio=0.05, fpr=0.01,
+        bloom_blocked="mod", policy="p0", memory="residual",
+        min_compress_size=100,
+    )
+    mesh = shared_mesh(4)
+    trainer = Trainer(TinyMLP(), cfg, optax.sgd(0.1), mesh)
+    x, y = _data()
+    batch = (x[:64], y[:64])
+    state0 = trainer.init_state(jax.random.PRNGKey(0), batch)
+    state1, _, _ = trainer.step(state0, batch, jax.random.PRNGKey(1))
+    state2, _, _ = trainer.step(state1, (x[64:128], y[64:128]), jax.random.PRNGKey(2))
+    donated = (
+        jax.tree_util.tree_leaves(state1.params)
+        + jax.tree_util.tree_leaves(state1.opt_state)
+        + jax.tree_util.tree_leaves(state1.residuals)
+    )
+    assert donated and all(leaf.is_deleted() for leaf in donated)
+    live = jax.tree_util.tree_leaves(state2.params) + jax.tree_util.tree_leaves(
+        state2.residuals
+    )
+    assert live and not any(leaf.is_deleted() for leaf in live)
+
+
 def test_compressed_matches_dense_trajectory_loosely():
     dense_cfg = DeepReduceConfig(communicator="allreduce", memory="none", deepreduce=None, compressor="none")
     comp_cfg = DeepReduceConfig(deepreduce=None, compress_ratio=0.25, memory="residual")

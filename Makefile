@@ -1,12 +1,19 @@
-.PHONY: analyze analyze-quick test test-quick telemetry-check
+.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
 # violation. CPU-only, trace-only (no compiles). Also exercises the
-# telemetry round trip (telemetry-check) so the observability path can't
-# rot while the gate stays green.
-analyze: telemetry-check
+# telemetry round trip (telemetry-check) and the resilience smoke
+# (chaos-check) so neither path can rot while the gate stays green.
+analyze: telemetry-check chaos-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
+
+# resilience smoke: a short 8-worker CPU-mesh train under a FaultPlan drop
+# schedule + wire corruption with payload checksums — asserts finite,
+# decreasing loss and incremented dropped_steps / checksum_failures
+# counters (python -m deepreduce_tpu.resilience check)
+chaos-check:
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.resilience --platform cpu check
 
 # end-to-end telemetry round trip on the CPU virtual mesh: a short
 # telemetry-on training run writes a tracked run dir (metrics + device

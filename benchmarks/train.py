@@ -77,6 +77,22 @@ def _resnet50():
     return ResNet50(), ("image", (224, 224, 3), 1000)
 
 
+@_register("mlp")
+def _mlp():
+    # tiny vector MLP: the fast model for smoke targets (chaos-check) and
+    # the kill/resume test — compiles in seconds on the CPU mesh
+    import flax.linen as nn
+
+    class _MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(64)(x))
+            x = nn.relu(nn.Dense(64)(x))
+            return nn.Dense(8)(x)
+
+    return _MLP(), ("vec", (32,), 8)
+
+
 @_register("ncf")
 def _ncf():
     from deepreduce_tpu.models import NeuMF
@@ -103,7 +119,7 @@ def _bert():
 def make_batch(kind, spec, classes, batch, rng, model=None):
     import jax.numpy as jnp
 
-    if kind == "image":
+    if kind in ("image", "vec"):
         x = jnp.asarray(rng.normal(size=(batch,) + spec).astype(np.float32))
         y = jnp.asarray(rng.integers(0, classes, size=batch), jnp.int32)
         return (x, y)
@@ -123,7 +139,7 @@ def make_loss(kind, model):
     import jax.numpy as jnp
     import optax
 
-    if kind == "image":
+    if kind in ("image", "vec"):
         from deepreduce_tpu.train import classification_loss
 
         return classification_loss(model)
@@ -182,8 +198,13 @@ def run(args) -> dict:
         loss_fn=make_loss(kind, model),
     )
 
-    rng = np.random.default_rng(0)
-    batch = make_batch(kind, spec, classes, args.batch_size, rng, model=model)
+    # per-purpose seeded streams (not one sequential stream): batch at step
+    # s is a pure function of (seed, s), so a resumed run regenerates the
+    # exact batches the killed run would have seen
+    batch = make_batch(
+        kind, spec, classes, args.batch_size,
+        np.random.default_rng((args.seed, 0, 0)), model=model,
+    )
     if kind == "ncf":
         sample = (batch[0], batch[1])
         init_batch = (batch[0], batch[1])
@@ -202,6 +223,40 @@ def run(args) -> dict:
             tags=[t for t in args.tags.split(",") if t],
         )
 
+    ckpt_path = None
+    if args.checkpoint_every or args.resume:
+        from deepreduce_tpu import checkpoint
+
+        ckpt_root = args.checkpoint_dir or (
+            str(tracker.dir / "ckpt") if tracker is not None else ""
+        )
+        if not ckpt_root:
+            raise ValueError(
+                "--checkpoint-every/--resume need --checkpoint-dir (or "
+                "--track_dir to default under the run directory)"
+            )
+        ckpt_path = pathlib.Path(ckpt_root) / "last"
+
+    start_step = 0
+    if args.resume and ckpt_path is not None and ckpt_path.exists():
+        from deepreduce_tpu import checkpoint
+
+        template = {"state": state}
+        if cfg.telemetry:
+            from deepreduce_tpu.telemetry import MetricAccumulators
+
+            template["telemetry"] = MetricAccumulators.zeros(
+                trainer.exchanger.num_buckets
+            )
+        restored = checkpoint.restore(str(ckpt_path), template, config=cfg)
+        state = restored["state"]
+        if cfg.telemetry:
+            # the accumulator resumes too: summaries keep counting from the
+            # killed run's totals instead of restarting at zero
+            trainer._telemetry_acc = restored["telemetry"]
+        start_step = int(state.step)
+        print(f"resumed from {ckpt_path} at step {start_step}", flush=True)
+
     key = jax.random.PRNGKey(args.seed + 1)
     losses = []
     profiling = False
@@ -214,16 +269,30 @@ def run(args) -> dict:
         profile_dir = None
     t0 = time.perf_counter()
     try:
-        for step in range(args.num_steps):
-            if profile_dir and step == 2 and not profiling:
+        for step in range(start_step, args.num_steps):
+            if profile_dir and step == start_step + 2 and not profiling:
                 jax.profiler.start_trace(profile_dir)  # skip compile steps
                 profiling = True
-            batch = make_batch(kind, spec, classes, args.batch_size, rng, model=model)
+            batch = make_batch(
+                kind, spec, classes, args.batch_size,
+                np.random.default_rng((args.seed, 1, step)), model=model,
+            )
             with spans.span("train/step"):
                 state, loss, wire = trainer.step(
                     state, batch, jax.random.fold_in(key, step)
                 )
             losses.append(float(loss))
+            if (
+                ckpt_path is not None
+                and args.checkpoint_every
+                and (step + 1) % args.checkpoint_every == 0
+            ):
+                from deepreduce_tpu import checkpoint
+
+                payload = {"state": state}
+                if cfg.telemetry:
+                    payload["telemetry"] = trainer._telemetry_acc
+                checkpoint.save(str(ckpt_path), payload, config=cfg)
             if tracker is not None:
                 rec = {"loss": losses[-1], "rel_volume": float(wire.rel_volume())}
                 if cfg.telemetry and (
@@ -255,14 +324,29 @@ def run(args) -> dict:
         jax.profiler.stop_trace()
     elapsed = time.perf_counter() - t0
 
+    if not losses:
+        # resumed at or past --num_steps: nothing left to run
+        result = {
+            "model": args.model,
+            "workers": n_dev,
+            "steps": 0,
+            "resumed_at": start_step,
+            "config": params,
+        }
+        print(json.dumps(result))
+        if tracker is not None:
+            tracker.finish(result)
+        return result
+
     result = {
         "model": args.model,
         "workers": n_dev,
         "steps": args.num_steps,
+        "resumed_at": start_step,
         "global_batch": args.batch_size,
         "first_loss": losses[0],
         "last_loss": losses[-1],
-        "examples_per_sec": args.batch_size * args.num_steps / elapsed,
+        "examples_per_sec": args.batch_size * len(losses) / elapsed,
         "rel_volume": float(wire.rel_volume()),
         "idx_rel_volume": float(wire.idx_rel_volume()),
         "val_rel_volume": float(wire.val_rel_volume()),
@@ -304,6 +388,18 @@ def main():
                     help="write a jax.profiler trace of the steady-state steps "
                          "(the reference's --log_time timing role, but a real "
                          "XLA trace instead of wall-clock prints)")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="save the full train state (params, opt state, "
+                         "residual EF memory, telemetry accumulator) every N "
+                         "steps via deepreduce_tpu.checkpoint (0 = off)")
+    ap.add_argument("--checkpoint-dir", type=str, default="",
+                    help="checkpoint directory (defaults to <run dir>/ckpt "
+                         "when --track_dir is set)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the last checkpoint in the checkpoint "
+                         "dir if one exists (config-fingerprint checked); "
+                         "batches are regenerated per step from --seed, so a "
+                         "killed run continues exactly")
     ap.add_argument("--platform", type=str, default="",
                     help="pin the JAX platform (e.g. 'cpu' for the 8-device "
                          "virtual mesh). Needed because env vars alone don't "

@@ -1,6 +1,6 @@
 """Repo-specific AST lint — the source-level half of the analysis gate.
 
-Four rules, each pinned to the scope where the hazard is real:
+Five rules, each pinned to the scope where the hazard is real:
 
 - ``ast-compat-route`` (repo-wide): `shard_map` / `pcast` must be imported
   from `deepreduce_tpu.utils.compat`, never from `jax.experimental.*`
@@ -21,6 +21,13 @@ Four rules, each pinned to the scope where the hazard is real:
   body is traced once and replayed, so a span there measures trace time
   and then silently never fires again — instrument the communicator and
   driver layers instead (comm.py, train.py, bench drivers).
+- ``ast-mask-host-branch`` (traced modules + train/fedavg): no Python
+  `if`/`while` whose test reads a participation-mask value (`mask`,
+  `row_weights`, ...). A host branch on a mask would bake one trace's
+  liveness pattern into the compiled step — the mask is per-step traced
+  data and must flow through `jnp.where`/arithmetic. The one allowed host
+  branch is the `is (not) None` presence gate, which is exactly the
+  Python-level zero-cost-off switch.
 
 Pure stdlib `ast`; no jax import, so this pass runs anywhere in
 milliseconds.
@@ -38,6 +45,7 @@ R_AST_COMPAT = "ast-compat-route"
 R_AST_ENTROPY = "ast-host-entropy"
 R_AST_BRANCH = "ast-traced-branch"
 R_AST_SPAN = "ast-span-outside-host"
+R_AST_MASK = "ast-mask-host-branch"
 
 # the one module allowed to touch jax.experimental.shard_map directly
 COMPAT_MODULE = "deepreduce_tpu/utils/compat.py"
@@ -54,7 +62,19 @@ TRACED_MODULES = (
     "deepreduce_tpu/qar.py",
     "deepreduce_tpu/sparse_rs.py",
     "deepreduce_tpu/wrappers.py",
+    "deepreduce_tpu/resilience/chaos.py",
+    "deepreduce_tpu/resilience/faults.py",
 )
+
+# scope of the mask-host-branch rule: every traced module plus the two
+# drivers that thread the participation mask through their jitted steps
+MASK_SCOPED_MODULES = TRACED_MODULES + (
+    "deepreduce_tpu/train.py",
+    "deepreduce_tpu/fedavg.py",
+)
+
+# identifiers the mask-host-branch rule treats as participation-mask values
+_MASK_NAMES = ("mask", "masks", "participation", "row_weights")
 
 # modules where a Python branch on an array value is always a bug
 CODEC_MODULES = (
@@ -206,6 +226,50 @@ def _span_violations(tree: ast.AST, relpath: str) -> List[Violation]:
     return out
 
 
+def _mentions_mask(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in _MASK_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _MASK_NAMES:
+            return True
+    return False
+
+
+def _branches_on_mask_value(expr: ast.AST) -> bool:
+    """True when the test reads a mask VALUE (not just its presence).
+    and/or/not decompose into their operands; an identity comparison
+    (`is` / `is not` — the `mask is not None` presence gate) never reads
+    the value; any other subexpression mentioning a mask name does."""
+    if isinstance(expr, ast.BoolOp):
+        return any(_branches_on_mask_value(v) for v in expr.values)
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        return _branches_on_mask_value(expr.operand)
+    if isinstance(expr, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops
+    ):
+        return False
+    return _mentions_mask(expr)
+
+
+def _mask_branch_violations(tree: ast.AST, relpath: str) -> List[Violation]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        if _branches_on_mask_value(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            out.append(
+                Violation(
+                    R_AST_MASK,
+                    f"{relpath}:{node.lineno}",
+                    f"Python `{kind}` on a participation-mask value — the "
+                    "mask is per-step traced data; branch with jnp.where / "
+                    "arithmetic (only `is None` presence gates may branch)",
+                )
+            )
+    return out
+
+
 def lint_source(src: str, relpath: str) -> List[Violation]:
     """Lint one module's source. `relpath` is repo-relative with forward
     slashes; it selects which rule scopes apply."""
@@ -219,6 +283,8 @@ def lint_source(src: str, relpath: str) -> List[Violation]:
         out.extend(_traced_branch_violations(tree, relpath))
     if _in_scope(relpath, SPAN_BANNED_MODULES):
         out.extend(_span_violations(tree, relpath))
+    if _in_scope(relpath, MASK_SCOPED_MODULES):
+        out.extend(_mask_branch_violations(tree, relpath))
     return out
 
 

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from deepreduce_tpu import memory
 from deepreduce_tpu.analysis.rules import (
     AuditContext,
+    R_RESILIENCE_OFF,
     R_RETRACE,
     Violation,
     collective_counts,
@@ -137,6 +138,57 @@ def trace_and_check(
     )
 
 
+def check_off_identical(
+    label: str,
+    make_fn: Callable[[], Callable],
+    args: Tuple[Any, ...],
+    patches: List[Tuple[Any, str, Any]],
+) -> TraceRecord:
+    """The zero-cost-off contract, checked on the trace: `make_fn` builds a
+    step program whose config has resilience DISABLED. Trace it as shipped,
+    then again with every resilience seam monkeypatched away entirely
+    (chaos perturb -> identity, participation_mask -> None, checksum verify
+    -> constant 1.0), and require byte-identical jaxpr hashes. If disabling
+    the knobs left ANY residue in the traced program — an extra select, a
+    checksum word, a mask broadcast — the two traces differ and this emits
+    jx-resilience-off-identical.
+
+    `patches` is a list of (object, attr, replacement) seams, setattr'd for
+    the second trace and restored in a finally block.
+
+    `make_fn` is a BUILDER, invoked once per trace: jax caches traces by
+    function identity, so re-tracing one shared callable after patching
+    would return the cached (unpatched) jaxpr and make the check vacuous —
+    every trace must go through freshly-built function objects."""
+    closed = jax.make_jaxpr(make_fn())(*args)
+    h_off = jaxpr_hash(closed)
+    saved = [(obj, attr, getattr(obj, attr)) for obj, attr, _ in patches]
+    try:
+        for obj, attr, repl in patches:
+            setattr(obj, attr, repl)
+        h_absent = jaxpr_hash(jax.make_jaxpr(make_fn())(*args))
+    finally:
+        for obj, attr, orig in saved:
+            setattr(obj, attr, orig)
+    violations: List[Violation] = []
+    if h_off != h_absent:
+        violations.append(
+            Violation(
+                R_RESILIENCE_OFF,
+                label,
+                f"resilience-off trace ({h_off}) differs from the "
+                f"resilience-absent trace ({h_absent}) — disabling the "
+                "knobs must leave a byte-identical program (zero-cost-off)",
+            )
+        )
+    return TraceRecord(
+        label=label,
+        violations=violations,
+        collectives=collective_counts(closed),
+        jaxpr_hash=h_off,
+    )
+
+
 def _sds(shape, dtype=jnp.float32):
     return jax.ShapeDtypeStruct(shape, dtype)
 
@@ -227,6 +279,7 @@ def audit_exchange(
     wire_mode: Optional[str] = None,
     enforce_sorted: bool = False,
     expect_codec: Optional[int] = None,
+    with_mask: bool = False,
     mesh=None,
 ) -> List[TraceRecord]:
     """Trace one full `exchange` step inside shard_map on the 8-way mesh.
@@ -235,6 +288,8 @@ def audit_exchange(
     a multi-leaf dict pytree — the shape the bucketed-exchange audits need.
     `expect_codec` arms jx-codec-count: the exact static count of
     sparsifier-selection eqns (O(leaves) per-tensor, O(buckets) bucketed).
+    `with_mask` threads a replicated bool[W] participation mask into the
+    exchange — the resilient-path audit shape (requires memory='residual').
     """
     from jax.sharding import PartitionSpec as P
 
@@ -249,7 +304,22 @@ def audit_exchange(
     pb = ex.payload_bytes(grads_like) if wire_mode is not None else None
     g_w = tmap(lambda s: _sds((NUM_WORKERS,) + s.shape), grads_like)
 
-    if with_state:
+    if with_mask and not with_state:
+        raise ValueError("with_mask audits require memory='residual'")
+    if with_mask:
+
+        def spmd(g, res, step, m):
+            g0 = tmap(lambda x: x[0], g)
+            res0 = tmap(lambda r: r[0], res)
+            agg, new_res, _ = ex.exchange(g0, res0, step=step, mask=m)
+            new_res = tmap(lambda r: r[None], new_res)
+            return tmap(lambda x: x[None], agg), new_res
+
+        fn = _shard_map(
+            spmd, mesh, (P(AXIS), P(AXIS), P(), P()), (P(AXIS), P(AXIS))
+        )
+        args = (g_w, g_w, _STEP, _sds((NUM_WORKERS,), jnp.bool_))
+    elif with_state:
 
         def spmd(g, res, step):
             g0 = tmap(lambda x: x[0], g)
@@ -290,6 +360,48 @@ def audit_exchange(
         expect_codec_invocations=expect_codec,
     )
     return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
+
+
+def audit_resilience_off(*, d: int = 4096) -> List[TraceRecord]:
+    """Zero-cost-off audit: the flagship fused exchange with every
+    resilience knob at its default must trace to a byte-identical jaxpr
+    when the resilience seams are monkeypatched out of existence — any
+    unconditional mask/chaos/checksum residue in the disabled program
+    trips jx-resilience-off-identical."""
+    from jax.sharding import PartitionSpec as P
+
+    import deepreduce_tpu.comm as comm_mod
+    from deepreduce_tpu.resilience import chaos as chaos_mod
+    from deepreduce_tpu.resilience import faults as faults_mod
+
+    cfg = DeepReduceConfig(memory="residual", decode_strategy="loop", **_FLAGSHIP)
+    mesh = audit_mesh()
+    g_w = _sds((NUM_WORKERS, d))
+
+    def make_fn():
+        # everything rebuilt per trace (exchanger included) so no stale
+        # trace cache can mask residue — see check_off_identical
+        ex = GradientExchanger(
+            _sds((d,)), cfg, axis_name=AXIS, num_workers=NUM_WORKERS
+        )
+
+        def spmd(g, res, step):
+            agg, new_res, _ = ex.exchange(g[0], res[0], step=step)
+            return agg[None], new_res[None]
+
+        return _shard_map(spmd, mesh, (P(AXIS), P(AXIS), P()), (P(AXIS), P(AXIS)))
+
+    args = (g_w, g_w, _STEP)
+    patches = [
+        (chaos_mod.ChaosInjector, "perturb", lambda self, buf, **kw: buf),
+        (faults_mod, "participation_mask", lambda *a, **kw: None),
+        (
+            comm_mod.PayloadLayout,
+            "verify",
+            lambda self, buf: jnp.ones((), jnp.float32),
+        ),
+    ]
+    return [check_off_identical("resilience:off-identical", make_fn, args, patches)]
 
 
 def _per_tensor_expected_gathers(cfg: DeepReduceConfig, d: int) -> int:
@@ -388,6 +500,23 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
             wire_mode="ring",
         ),
     )
+    # --- resilience: the masked/checksummed fused path still shows exactly
+    # one all_gather whose operand bytes match payload_bytes() (the psum(1)
+    # live-count in train.py constant-folds; the mask denominator is a local
+    # reduction over the replicated mask, not a collective) ---
+    add(
+        "exchange:fused-loop-resilient",
+        lambda: audit_exchange(
+            "exchange:fused-loop-resilient",
+            C(memory="residual", decode_strategy="loop", resilience=True,
+              payload_checksum=True, chaos_corrupt_rate=0.2, **_FLAGSHIP),
+            expect={"all_gather": 1},
+            wire_mode="allgather",
+            with_mask=True,
+        ),
+    )
+    # --- resilience off must be zero-cost (byte-identical trace) ---
+    add("resilience:off-identical", lambda: audit_resilience_off())
     if quick:
         return specs
 

@@ -40,6 +40,10 @@ R_WIRE_ACCOUNTING = "jx-wire-accounting"
 R_CALLBACK = "jx-callback"
 R_CODEC_COUNT = "jx-codec-count"
 R_RETRACE = "jx-retrace"  # emitted by the audit harness (two-trace hash)
+# emitted by the audit harness (check_off_identical): the resilience-off
+# step program must trace to a byte-identical jaxpr with every resilience
+# seam (mask / chaos / checksum) stubbed out — the zero-cost-off contract
+R_RESILIENCE_OFF = "jx-resilience-off-identical"
 
 ALL_RULE_IDS = (
     R_F64,
@@ -51,6 +55,7 @@ ALL_RULE_IDS = (
     R_CALLBACK,
     R_CODEC_COUNT,
     R_RETRACE,
+    R_RESILIENCE_OFF,
 )
 
 # sparsifier-selection primitives: every TensorCodec encode lowers its

@@ -5,43 +5,167 @@ The reference delegates checkpointing entirely to its benchmark drivers
 run_deepreduce.sh:11,49) and does NOT checkpoint the residual error-feedback
 memory (SURVEY.md §5) — resuming silently drops accumulated gradient mass.
 Here the full `TrainState` (params, batch stats, optimizer state, residuals,
-step) round-trips through orbax, fixing that gap."""
+step) round-trips through orbax, fixing that gap.
+
+Resilience hardening (two host-side gaps this module closes):
+
+- **Config fingerprint**: a checkpoint restores into any same-shaped
+  config — residuals written under one codec stack silently reinterpret
+  under another, changing semantics mid-run. `save(..., config=cfg)`
+  stamps a fingerprint of the codec-relevant config fields into a sibling
+  ``<path>.config.json``; `restore(..., config=cfg)` fails fast on
+  mismatch. Observability-only knobs (telemetry, micro_benchmark) are
+  excluded, so toggling them never blocks a resume.
+- **Transient I/O**: orbax save/restore and the stamp read/write route
+  through `resilience.retry.retry_io` (deterministic exponential backoff
+  on OSError).
+"""
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import pathlib
-from typing import Optional
+from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
 
-from deepreduce_tpu.train import TrainState
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.resilience.retry import retry_io
+from deepreduce_tpu.train import TrainState  # noqa: F401  (re-export: templates)
+
+# config fields that change what is *observed*, never what is *computed* —
+# a checkpoint written with telemetry off must restore under telemetry on
+_OBSERVABILITY_FIELDS = frozenset({"telemetry", "telemetry_every", "micro_benchmark"})
 
 
-def save(path: str, state: TrainState, *, force: bool = True) -> None:
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(pathlib.Path(path).absolute(), state, force=force)
-    ckptr.wait_until_finished()
+def config_fingerprint(cfg: DeepReduceConfig) -> str:
+    """Stable hex fingerprint of the semantics-bearing config fields."""
+    d = dataclasses.asdict(cfg)
+    for f in _OBSERVABILITY_FIELDS:
+        d.pop(f, None)
+    blob = json.dumps(d, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
-def restore(path: str, template: TrainState) -> TrainState:
+def _stamp_path(path) -> pathlib.Path:
+    # a SIBLING of the orbax directory, not inside it — orbax owns (and on
+    # save with force=True, deletes) the checkpoint directory's contents
+    return pathlib.Path(str(pathlib.Path(path).absolute()) + ".config.json")
+
+
+def _write_stamp(path, cfg: DeepReduceConfig) -> None:
+    d = dataclasses.asdict(cfg)
+    stamp = {
+        "fingerprint": config_fingerprint(cfg),
+        "config": {k: (v if isinstance(v, (int, float, bool, str, type(None))) else str(v)) for k, v in d.items()},
+    }
+
+    def _write():
+        with open(_stamp_path(path), "w") as f:
+            json.dump(stamp, f, sort_keys=True, indent=2)
+
+    retry_io(_write)
+
+
+def _check_stamp(path, cfg: DeepReduceConfig) -> None:
+    sp = _stamp_path(path)
+    if not sp.exists():
+        return  # legacy checkpoint without a stamp: tolerated
+    stamp = retry_io(lambda: json.loads(sp.read_text()))
+    want = config_fingerprint(cfg)
+    got = stamp.get("fingerprint")
+    if got != want:
+        raise ValueError(
+            f"checkpoint config mismatch: {sp} was written under config "
+            f"fingerprint {got!r} but this run's config fingerprints to "
+            f"{want!r} — restoring would silently change codec semantics "
+            "mid-run. Use the original config, or delete the checkpoint to "
+            "start fresh."
+        )
+
+
+# orbax's ocdbt driver refuses zero-size arrays ("N params are missing in
+# checkpoint") — e.g. a telemetry accumulator's bucket_saturated is shape
+# (0,) for non-bucketed configs. Zero-size leaves carry no data, so they
+# round-trip as a 1-element placeholder on disk and are rebuilt from the
+# restore template's shape.
+def _is_zero_size(x: Any) -> bool:
+    return getattr(x, "size", 1) == 0
+
+
+def _pad_zero_size(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros((1,), x.dtype) if _is_zero_size(x) else x, tree
+    )
+
+
+def save(
+    path: str, state: Any, *, force: bool = True, config: Optional[DeepReduceConfig] = None
+) -> None:
+    """Persist any pytree (a TrainState, or a composite like
+    ``{"state": ..., "telemetry": acc}``). `config`, when given, stamps
+    the sibling fingerprint file `restore` checks against."""
+    def _save():
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(pathlib.Path(path).absolute(), _pad_zero_size(state), force=force)
+        ckptr.wait_until_finished()
+
+    retry_io(_save)
+    if config is not None:
+        _write_stamp(path, config)
+
+
+def restore(path: str, template: Any, *, config: Optional[DeepReduceConfig] = None) -> Any:
     """Restore into the shape/dtype structure of `template` (build it with
-    Trainer.init_state on the same config/mesh)."""
-    ckptr = ocp.StandardCheckpointer()
-    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
-    return ckptr.restore(pathlib.Path(path).absolute(), abstract)
+    Trainer.init_state on the same config/mesh). With `config`, fail fast
+    if the checkpoint's stamped config fingerprint doesn't match."""
+    if config is not None:
+        _check_stamp(path, config)
+
+    def _restore():
+        ckptr = ocp.StandardCheckpointer()
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, _pad_zero_size(template)
+        )
+        return ckptr.restore(pathlib.Path(path).absolute(), abstract)
+
+    out = retry_io(_restore)
+
+    # orbax hands back arrays committed to device 0; a fresh init_state's
+    # arrays are uncommitted, so jit is free to place them on the mesh.
+    # Round-trip through host memory to drop the commitment — otherwise the
+    # first post-resume step fails with "incompatible devices".
+    def _uncommit(t, r):
+        if _is_zero_size(t):
+            return jnp.zeros(t.shape, t.dtype)
+        return jnp.asarray(np.asarray(r))
+
+    return jax.tree_util.tree_map(_uncommit, template, out)
 
 
 def save_common_init(path: str, params) -> None:
     """The reference's `model_init.pth` common-initialization trick
     (run_deepreduce.sh:49): persist initial params so every worker/job starts
     identically."""
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(pathlib.Path(path).absolute(), params, force=True)
-    ckptr.wait_until_finished()
+    def _save():
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(pathlib.Path(path).absolute(), params, force=True)
+        ckptr.wait_until_finished()
+
+    retry_io(_save)
 
 
 def load_common_init(path: str, params_template):
-    ckptr = ocp.StandardCheckpointer()
-    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, params_template)
-    return ckptr.restore(pathlib.Path(path).absolute(), abstract)
+    def _load():
+        ckptr = ocp.StandardCheckpointer()
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, params_template
+        )
+        return ckptr.restore(pathlib.Path(path).absolute(), abstract)
+
+    return retry_io(_load)

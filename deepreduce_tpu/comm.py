@@ -35,6 +35,7 @@ import numpy as np
 
 from deepreduce_tpu import comm_ring, memory
 from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.resilience.chaos import ChaosInjector
 from deepreduce_tpu.metrics import (
     WireStats,
     combine,
@@ -58,7 +59,7 @@ class PayloadLayout:
     computed once from abstract shapes and the packing is pure slicing —
     no per-step host work, no dynamic shapes for XLA."""
 
-    def __init__(self, payload_sds: Any):
+    def __init__(self, payload_sds: Any, *, checksum: bool = False):
         leaves, self.treedef = jax.tree_util.tree_flatten(payload_sds)
         self.specs: List[Tuple[Tuple[int, ...], Any]] = [
             (tuple(int(s) for s in l.shape), jnp.dtype(l.dtype)) for l in leaves
@@ -66,7 +67,24 @@ class PayloadLayout:
         self.leaf_bytes = [
             int(np.prod(s, dtype=np.int64)) * dt.itemsize for s, dt in self.specs
         ]
-        self.nbytes = int(sum(self.leaf_bytes))
+        self.checksum = bool(checksum)
+        self.payload_nbytes = int(sum(self.leaf_bytes))
+        # wire footprint: payload bytes plus the optional trailing uint32
+        # checksum word (resilience). `unpack` only walks the payload
+        # offsets, so the tail is invisible to it either way.
+        self.nbytes = self.payload_nbytes + (4 if self.checksum else 0)
+
+    @staticmethod
+    def _checksum_word(body: jax.Array) -> jax.Array:
+        """Position-weighted uint32 checksum of the payload bytes. The
+        per-position weights make byte order matter (a plain byte sum
+        would miss transpositions); the XOR salt makes an all-zero buffer
+        FAIL against its own zeroed word, so a chaos 'drop' (fully zeroed
+        payload) is always detected."""
+        n = body.shape[0]
+        w = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761) + jnp.uint32(1)
+        s = jnp.sum(body.astype(jnp.uint32) * w, dtype=jnp.uint32)
+        return s ^ jnp.uint32(0xA5A5A5A5)
 
     def pack(self, payload: Any) -> jax.Array:
         """payload pytree -> uint8[nbytes] (bitcast, zero-copy in XLA)."""
@@ -81,9 +99,27 @@ class PayloadLayout:
             else:
                 x = jax.lax.bitcast_convert_type(x, jnp.uint8)
             segs.append(x)
-        if not segs:
-            return jnp.zeros((0,), jnp.uint8)
-        return jnp.concatenate(segs)
+        body = jnp.concatenate(segs) if segs else jnp.zeros((0,), jnp.uint8)
+        if not self.checksum:
+            return body
+        word = self._checksum_word(body)
+        tail = jax.lax.bitcast_convert_type(word[None], jnp.uint8).reshape(-1)
+        return jnp.concatenate([body, tail])
+
+    def verify(self, buf: jax.Array) -> jax.Array:
+        """f32 validity gate over one packed buffer: 1.0 when the stored
+        checksum word matches the payload bytes (or checksum is off), else
+        0.0. Callers gate the decoded leaf with a `where` select on this
+        value rather than a host branch, so decode stays branch-free under
+        tracing — a failed payload degrades to an exact-zero contribution
+        instead of NaN (corrupt bytes can decode to Inf/NaN, so the select,
+        not a multiply, does the zeroing)."""
+        if not self.checksum:
+            return jnp.ones((), jnp.float32)
+        body = buf[: self.payload_nbytes]
+        tail = buf[self.payload_nbytes : self.nbytes]
+        stored = jax.lax.bitcast_convert_type(tail.reshape(1, 4), jnp.uint32)[0]
+        return (stored == self._checksum_word(body)).astype(jnp.float32)
 
     def unpack(self, buf: jax.Array) -> Any:
         """uint8[nbytes] -> payload pytree (inverse of pack)."""
@@ -104,7 +140,14 @@ class PayloadLayout:
 
 
 def decode_gathered_loop(
-    gathered, num_workers, decode_row, out_shapes, *, axis_name: str, need_own: bool
+    gathered,
+    num_workers,
+    decode_row,
+    out_shapes,
+    *,
+    axis_name: str,
+    need_own: bool,
+    row_weights=None,
 ):
     """Sequential fori_loop over gathered workers (the original shape):
     O(W·d) serial decode on the critical path, but only ONE dense
@@ -112,7 +155,13 @@ def decode_gathered_loop(
     to a tuple of f32 arrays shaped like `out_shapes`; the own-row decode
     (residual error-feedback) is folded into the same loop with a select
     at w == my_index, so the decode program is traced once. Shared by the
-    whole-pytree fused path and the per-bucket decodes (comm_bucket.py)."""
+    whole-pytree fused path and the per-bucket decodes (comm_bucket.py).
+
+    `row_weights` (f32[W] or None) scales each worker's decode BEFORE both
+    the accumulator and the own-row select: a masked-out worker (weight 0)
+    contributes nothing to the aggregate AND its own decode is zero, so
+    `memory.update` keeps its whole compensated gradient in the residual —
+    elastic-participation re-delivery rides the existing EF machinery."""
     widx = jax.lax.axis_index(axis_name)
     acc0 = tuple(jnp.zeros(s, jnp.float32) for s in out_shapes)
     own0 = acc0 if need_own else ()
@@ -121,6 +170,9 @@ def decode_gathered_loop(
         acc, own = carry
         row = jax.lax.dynamic_index_in_dim(gathered, w, keepdims=False)
         decs = decode_row(row)
+        if row_weights is not None:
+            wgt = jax.lax.dynamic_index_in_dim(row_weights, w, keepdims=False)
+            decs = tuple(d * wgt for d in decs)
         new_acc = tuple(a + dec for a, dec in zip(acc, decs))
         new_own = (
             tuple(jnp.where(w == widx, dec, o) for dec, o in zip(decs, own))
@@ -141,6 +193,7 @@ def decode_gathered_vmap(
     axis_name: str,
     need_own: bool,
     decode_batch: int,
+    row_weights=None,
 ):
     """Batched decode: the [W, B] gathered buffer is decoded in static
     groups of `decode_batch` rows under jax.vmap — one wide kernel per
@@ -148,7 +201,8 @@ def decode_gathered_vmap(
     peak memory bounded at decode_batch dense tensors per output. The
     own-row decode is recovered by a masked sum over each group's rows
     (adding exact zeros), so the decode program is still traced once
-    (vmapped), never a second unbatched time."""
+    (vmapped), never a second unbatched time. `row_weights` scales each
+    worker's decode before both sums (see decode_gathered_loop)."""
     W = int(num_workers)
     G = max(1, min(int(decode_batch), W))
     widx = jax.lax.axis_index(axis_name)
@@ -158,6 +212,11 @@ def decode_gathered_vmap(
     for g0 in range(0, W, G):
         g1 = min(g0 + G, W)
         decs = vdec(jax.lax.slice_in_dim(gathered, g0, g1))  # [g, ...] each
+        if row_weights is not None:
+            wseg = jax.lax.slice_in_dim(row_weights, g0, g1)  # [g]
+            decs = tuple(
+                d * wseg.reshape((-1,) + (1,) * (d.ndim - 1)) for d in decs
+            )
         acc = tuple(a + d.sum(axis=0) for a, d in zip(acc, decs))
         if need_own:
             mine = jnp.arange(g0, g1) == widx  # [g] one-hot or all-false
@@ -223,6 +282,10 @@ class GradientExchanger:
         self._layouts: Optional[Dict[str, PayloadLayout]] = None
         self._offsets: Dict[str, int] = {}
         self._fused_nbytes = 0
+        # resilience seams: both None/False unless configured, so the
+        # default program contains no chaos or checksum ops at all
+        self._chaos = ChaosInjector.from_config(cfg)
+        self._checksum = bool(cfg.payload_checksum)
         if cfg.bucket_bytes is not None:
             if not (cfg.fused and cfg.communicator == "allgather"):
                 raise ValueError(
@@ -279,7 +342,9 @@ class GradientExchanger:
                         lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)),
                         g_sds,
                     )
-                    self._layouts[name] = PayloadLayout(payload_sds)
+                    self._layouts[name] = PayloadLayout(
+                        payload_sds, checksum=self._checksum
+                    )
                     self._offsets[name] = self._fused_nbytes
                     self._fused_nbytes += self._layouts[name].nbytes
         if (
@@ -326,6 +391,7 @@ class GradientExchanger:
         step: jax.Array = 0,
         key: Optional[jax.Array] = None,
         collect: Optional[Dict[str, jax.Array]] = None,
+        mask: Optional[jax.Array] = None,
     ) -> Tuple[Any, Any, WireStats]:
         """Inside shard_map over `axis_name`: returns (aggregated dense
         grads, new residual state, combined wire stats).
@@ -336,13 +402,38 @@ class GradientExchanger:
         false positives, measured by the codec's own `fp_stats` query) and
         ``fp_universe`` (the not-selected universe, the FPR denominator).
         Adds a d-scale filter query per bloom tensor, so only pass it when
-        `cfg.telemetry` is enabled."""
+        `cfg.telemetry` is enabled.
+
+        `mask` (bool[W], replicated across workers, or None) is the
+        elastic-participation vector: a False worker's payload is scaled
+        to zero on the decode side and the mean renormalizes by the live
+        count (traced `jnp.sum` — never host control flow). Its own-row
+        decode is zeroed too, so its residual EF accumulator retains the
+        un-sent gradient mass for re-delivery on rejoin."""
         cfg = self.cfg
+        if mask is not None and cfg.communicator in ("qar", "sparse_rs"):
+            raise ValueError(
+                f"participation masks renormalize the decode-side mean of the "
+                f"allgather/allreduce paths; communicator={cfg.communicator!r} "
+                "reduces inside the collective and would silently ignore the "
+                "mask — use communicator='allgather' or 'allreduce'"
+            )
         num_workers = jax.lax.psum(1, self.axis_name)
         if collect is not None:
             zero = jnp.zeros((), jnp.float32)
             collect.setdefault("fp_count", zero)
             collect.setdefault("fp_universe", zero)
+            if self._checksum:
+                collect.setdefault("checksum_failures", zero)
+        # masked aggregation: weight each worker's decode by its mask entry
+        # and divide by the live count instead of W. Both stay None on the
+        # mask-free path so the traced program is byte-identical to pre-
+        # resilience builds (jx-resilience-off-identical pins this).
+        row_weights = None
+        denom = None
+        if mask is not None:
+            row_weights = mask.astype(jnp.float32)
+            denom = jnp.maximum(jnp.sum(row_weights), 1.0)
 
         if cfg.communicator == "qar":
             return self._exchange_qar(grads, state, step=step, key=key)
@@ -351,9 +442,15 @@ class GradientExchanger:
 
         if cfg.communicator == "allreduce" or cfg.deepreduce is None and cfg.compressor == "none":
             # dense baseline: NCCL allreduce -> psum (run_deepreduce.sh:51)
-            agg = jax.tree_util.tree_map(
-                lambda g: jax.lax.psum(g, self.axis_name) / num_workers, grads
-            )
+            if mask is not None:
+                me = row_weights[jax.lax.axis_index(self.axis_name)]
+                agg = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g * me, self.axis_name) / denom, grads
+                )
+            else:
+                agg = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, self.axis_name) / num_workers, grads
+                )
             dense_bits = sum(
                 jnp.asarray(c.d * 32, jnp.float32) for c in self.codecs.values()
             )
@@ -380,7 +477,14 @@ class GradientExchanger:
 
         if self._bucketed is not None:
             agg_leaves, own_leaves, stats_per, payloads = self._bucketed.run(
-                flat_grads, num_workers, step, worker_key, need_own=need_own
+                flat_grads,
+                num_workers,
+                step,
+                worker_key,
+                need_own=need_own,
+                row_weights=row_weights,
+                denom=denom,
+                collect=collect,
             )
             codecs = self._bucketed.codecs
             if collect is not None:
@@ -401,11 +505,22 @@ class GradientExchanger:
 
             if self._layouts is not None:
                 agg_leaves, own_leaves = self._exchange_fused(
-                    payloads, num_workers, step, need_own=need_own
+                    payloads,
+                    num_workers,
+                    step,
+                    need_own=need_own,
+                    row_weights=row_weights,
+                    denom=denom,
+                    collect=collect,
                 )
             else:
                 agg_leaves, own_leaves = self._exchange_per_tensor(
-                    payloads, num_workers, step, need_own=need_own
+                    payloads,
+                    num_workers,
+                    step,
+                    need_own=need_own,
+                    row_weights=row_weights,
+                    denom=denom,
                 )
 
         if collect is not None:
@@ -442,28 +557,37 @@ class GradientExchanger:
         return agg, new_state, combine(stats_per)
 
     def _exchange_per_tensor(
-        self, payloads, num_workers, step, *, need_own: bool
+        self, payloads, num_workers, step, *, need_own: bool, row_weights=None, denom=None
     ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
         """The reference's shape: one all_gather per gradient tensor
         (pytorch/deepreduce.py:54-61), sequential worker decode. Returns
-        f32 leaves; `exchange` casts back to the runtime gradient dtype."""
+        f32 leaves; `exchange` casts back to the runtime gradient dtype.
+        `row_weights`/`denom` implement masked participation exactly as in
+        decode_gathered_loop (weight before both sums, live-count mean)."""
+        den = denom if denom is not None else num_workers
         agg_leaves, own_leaves = {}, {}
         for name in self.names:
             codec = self.codecs[name]
             payload = payloads[name]
             if need_own:
-                own_leaves[name] = codec.decode(payload, step=step).astype(
-                    jnp.float32
-                )
+                own = codec.decode(payload, step=step).astype(jnp.float32)
+                if row_weights is not None:
+                    own = own * row_weights[jax.lax.axis_index(self.axis_name)]
+                own_leaves[name] = own
             gathered = jax.lax.all_gather(payload, self.axis_name)  # leading axis W
 
             def body(w, acc, _gathered=gathered, _codec=codec):
                 p_w = jax.tree_util.tree_map(lambda x: x[w], _gathered)
-                return acc + _codec.decode(p_w, step=step)
+                dec = _codec.decode(p_w, step=step)
+                if row_weights is not None:
+                    dec = dec * jax.lax.dynamic_index_in_dim(
+                        row_weights, w, keepdims=False
+                    )
+                return acc + dec
 
             acc0 = jnp.zeros(codec.shape, jnp.float32)
             total = jax.lax.fori_loop(0, num_workers, body, acc0)
-            agg_leaves[name] = total / num_workers
+            agg_leaves[name] = total / den
         return agg_leaves, own_leaves
 
     def _pack_fused(self, payloads) -> jax.Array:
@@ -477,16 +601,43 @@ class GradientExchanger:
         """One worker's uint8[B] fused buffer -> tuple of dense f32 leaves
         (ordered like self.names). The shared decode program of all three
         decode strategies — bit-compatibility across strategies is this
-        function being the single source of truth."""
+        function being the single source of truth.
+
+        With payload checksums on, each tensor's decode is gated by its
+        layout's `verify` word (failed checksum -> exact zero leaf) and a
+        trailing scalar counts the failures in this row; the decode
+        helpers treat it as just another f32 output of shape ()."""
         out = []
+        fails = jnp.zeros((), jnp.float32)
         for name in self.names:
+            layout = self._layouts[name]
             lo = self._offsets[name]
-            p_w = self._layouts[name].unpack(row[lo : lo + self._layouts[name].nbytes])
-            out.append(self.codecs[name].decode(p_w, step=step).astype(jnp.float32))
+            seg = row[lo : lo + layout.nbytes]
+            dec = self.codecs[name].decode(layout.unpack(seg), step=step).astype(
+                jnp.float32
+            )
+            if self._checksum:
+                ok = layout.verify(seg)
+                # where-select, not `dec * ok`: corrupted bytes can decode
+                # to Inf/NaN, and Inf * 0 is NaN — the select yields an
+                # exact zero regardless of the decoded garbage
+                dec = jnp.where(ok > 0.5, dec, jnp.zeros_like(dec))
+                fails = fails + (1.0 - ok)
+            out.append(dec)
+        if self._checksum:
+            out.append(fails)
         return tuple(out)
 
     def _exchange_fused(
-        self, payloads, num_workers, step, *, need_own: bool
+        self,
+        payloads,
+        num_workers,
+        step,
+        *,
+        need_own: bool,
+        row_weights=None,
+        denom=None,
+        collect=None,
     ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
         """TPU-native shape: every tensor's payload bitcast into ONE uint8
         buffer, then one of three decode strategies (cfg.decode_strategy):
@@ -509,6 +660,15 @@ class GradientExchanger:
         with spans.span("exchange/pack"):
             buf = self._pack_fused(payloads)
 
+        if self._chaos is not None:
+            # the wire boundary: perturb AFTER pack (checksum included), so
+            # the decode side sees corrupt bytes exactly as a lossy
+            # transport would deliver them — the own-row decode included
+            with spans.span("resilience/chaos"):
+                buf = self._chaos.perturb(
+                    buf, step=step, worker=jax.lax.axis_index(self.axis_name)
+                )
+
         if strategy == "ring":
             total, own_fin = comm_ring.ring_decode_exchange(
                 buf,
@@ -516,6 +676,7 @@ class GradientExchanger:
                 axis_name=self.axis_name,
                 num_workers=num_workers,
                 need_own=need_own,
+                row_weights=row_weights,
             )
         else:
             with spans.span("exchange/allgather"):
@@ -527,36 +688,61 @@ class GradientExchanger:
             )
             with spans.span("exchange/decode"):
                 total, own_fin = decoder(
-                    gathered, num_workers, step, need_own=need_own
+                    gathered,
+                    num_workers,
+                    step,
+                    need_own=need_own,
+                    row_weights=row_weights,
                 )
 
-        agg_leaves = {name: t / num_workers for name, t in zip(self.names, total)}
+        if self._checksum:
+            # the trailing scalar is the replicated failure count over all
+            # gathered rows — every worker decodes the same [W, B] buffer,
+            # so no psum is needed. (Masked-out rows are weighted to zero
+            # before the sum, so their failures don't count — their
+            # contribution was discarded anyway.)
+            if collect is not None:
+                collect["checksum_failures"] = total[-1]
+            total = total[:-1]
+            if need_own:
+                own_fin = own_fin[:-1]
+
+        den = denom if denom is not None else num_workers
+        agg_leaves = {name: t / den for name, t in zip(self.names, total)}
         own_leaves = dict(zip(self.names, own_fin)) if need_own else {}
         return agg_leaves, own_leaves
 
+    def _fused_out_shapes(self) -> Tuple[Tuple[int, ...], ...]:
+        shapes = tuple(self.codecs[n].shape for n in self.names)
+        if self._checksum:
+            shapes = shapes + ((),)  # the per-row checksum-failure count
+        return shapes
+
     def _decode_gathered_loop(
-        self, gathered, num_workers, step, *, need_own: bool
+        self, gathered, num_workers, step, *, need_own: bool, row_weights=None
     ) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...]]:
         return decode_gathered_loop(
             gathered,
             num_workers,
             lambda row: self._decode_fused_row(row, step),
-            tuple(self.codecs[n].shape for n in self.names),
+            self._fused_out_shapes(),
             axis_name=self.axis_name,
             need_own=need_own,
+            row_weights=row_weights,
         )
 
     def _decode_gathered_vmap(
-        self, gathered, num_workers, step, *, need_own: bool
+        self, gathered, num_workers, step, *, need_own: bool, row_weights=None
     ) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...]]:
         return decode_gathered_vmap(
             gathered,
             num_workers,
             lambda row: self._decode_fused_row(row, step),
-            tuple(self.codecs[n].shape for n in self.names),
+            self._fused_out_shapes(),
             axis_name=self.axis_name,
             need_own=need_own,
             decode_batch=self.cfg.decode_batch,
+            row_weights=row_weights,
         )
 
     def _exchange_sparse_rs(
@@ -689,13 +875,21 @@ class GradientExchanger:
             # bucketed all_gather operands carry (jx-wire-accounting checks
             # this equality against the traced jaxpr)
             return self._bucketed.payload_nbytes
-        total = 0
-        flat = dict(zip(self.names, jax.tree_util.tree_leaves(grads_like)))
-        for name, codec in self.codecs.items():
-            payload_shape = jax.eval_shape(
-                lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)), flat[name]
-            )
-            total += payload_device_bytes(payload_shape)
+        if self._layouts is not None:
+            # the fused buffer's exact byte count — includes the optional
+            # per-tensor checksum words, which DO cross the wire (the
+            # jx-wire-accounting rule compares this against the traced
+            # all_gather operand)
+            total = self._fused_nbytes
+        else:
+            total = 0
+            flat = dict(zip(self.names, jax.tree_util.tree_leaves(grads_like)))
+            for name, codec in self.codecs.items():
+                payload_shape = jax.eval_shape(
+                    lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)),
+                    flat[name],
+                )
+                total += payload_device_bytes(payload_shape)
         if self.cfg.decode_strategy == "ring":
             # explicit W-1 ppermute hops: each forwards the whole fused
             # buffer, so per-worker wire is (W-1)·B, not the allgather

@@ -45,7 +45,8 @@ from deepreduce_tpu.comm import (
     decode_gathered_vmap,
 )
 from deepreduce_tpu.config import DeepReduceConfig
-from deepreduce_tpu.metrics import WireStats, payload_device_bytes
+from deepreduce_tpu.metrics import WireStats
+from deepreduce_tpu.resilience.chaos import ChaosInjector
 from deepreduce_tpu.sparse import bucket_num_slots, per_tensor_key
 from deepreduce_tpu.telemetry import spans
 from deepreduce_tpu.wrappers import TensorCodec
@@ -196,8 +197,14 @@ class BucketedExchanger:
                 jax.ShapeDtypeStruct((spec.total,), jnp.float32),
             )
             self.codecs[spec.label] = codec
-            self.layouts[spec.label] = PayloadLayout(payload_sds)
-            self.payload_nbytes += payload_device_bytes(payload_sds)
+            self.layouts[spec.label] = PayloadLayout(
+                payload_sds, checksum=bool(cfg.payload_checksum)
+            )
+            # the layout's exact wire size — includes the optional trailing
+            # checksum word, which the all_gather operand carries too
+            self.payload_nbytes += self.layouts[spec.label].nbytes
+        self._chaos = ChaosInjector.from_config(cfg)
+        self._checksum = bool(cfg.payload_checksum)
 
     @property
     def num_buckets(self) -> int:
@@ -221,42 +228,79 @@ class BucketedExchanger:
             for n, size, off in zip(spec.names, spec.sizes, spec.offsets)
         }
 
-    def _decode_bucket(self, spec, gathered, num_workers, step, *, need_own):
+    def _decode_bucket(
+        self, spec, gathered, num_workers, step, *, need_own, row_weights=None
+    ):
+        """Returns (total, own, fails): the over-workers decode sum, this
+        worker's own decode (None unless need_own), and the bucket's
+        checksum-failure count over gathered rows (None unless checksums
+        are on). Failed-checksum rows decode to an exact zero vector."""
         codec = self.codecs[spec.label]
         layout = self.layouts[spec.label]
 
-        def decode_row(row):
-            return (
-                codec.decode(layout.unpack(row), step=step).astype(jnp.float32),
-            )
+        if self._checksum:
+
+            def decode_row(row):
+                ok = layout.verify(row)
+                dec = codec.decode(layout.unpack(row), step=step).astype(jnp.float32)
+                # where-select, not `dec * ok`: corrupt bytes can decode to
+                # Inf/NaN and Inf * 0 is NaN — the select stays exact zero
+                return (jnp.where(ok > 0.5, dec, jnp.zeros_like(dec)), 1.0 - ok)
+
+            out_shapes = ((spec.total,), ())
+        else:
+
+            def decode_row(row):
+                return (
+                    codec.decode(layout.unpack(row), step=step).astype(jnp.float32),
+                )
+
+            out_shapes = ((spec.total,),)
 
         if self.cfg.decode_strategy == "vmap":
             total, own = decode_gathered_vmap(
                 gathered,
                 num_workers,
                 decode_row,
-                ((spec.total,),),
+                out_shapes,
                 axis_name=self.axis_name,
                 need_own=need_own,
                 decode_batch=self.cfg.decode_batch,
+                row_weights=row_weights,
             )
         else:
             total, own = decode_gathered_loop(
                 gathered,
                 num_workers,
                 decode_row,
-                ((spec.total,),),
+                out_shapes,
                 axis_name=self.axis_name,
                 need_own=need_own,
+                row_weights=row_weights,
             )
-        return total[0], (own[0] if need_own else None)
+        fails = total[1] if self._checksum else None
+        return total[0], (own[0] if need_own else None), fails
 
-    def run(self, flat_grads, num_workers, step, worker_key, *, need_own: bool):
+    def run(
+        self,
+        flat_grads,
+        num_workers,
+        step,
+        worker_key,
+        *,
+        need_own: bool,
+        row_weights=None,
+        denom=None,
+        collect=None,
+    ):
         """Full bucketed exchange over the compensated flat-gradient dict.
 
         Returns ``(agg_leaves, own_leaves, stats_per, payloads)`` where the
         leaf dicts are keyed like ``flat_grads`` (f32, mean over workers /
         this worker's decode) and stats/payloads are keyed by bucket label.
+        ``row_weights``/``denom`` carry the participation mask (see
+        GradientExchanger.exchange); checksum failures summed over buckets
+        land in ``collect["checksum_failures"]``.
         """
         payloads: Dict[str, object] = {}
         stats_per: Dict[str, WireStats] = {}
@@ -272,14 +316,30 @@ class BucketedExchanger:
         with spans.span("exchange/pack"):
             bufs = [self.layouts[s.label].pack(payloads[s.label]) for s in self.specs]
 
+        if self._chaos is not None:
+            # per-bucket salt: each bucket draws its own fault events, so a
+            # chaotic step doesn't corrupt every bucket in lockstep
+            widx = jax.lax.axis_index(self.axis_name)
+            with spans.span("resilience/chaos"):
+                bufs = [
+                    self._chaos.perturb(buf, step=step, worker=widx, salt=b)
+                    for b, buf in enumerate(bufs)
+                ]
+
         C = len(self.specs)
         totals: List = [None] * C
         owns: List = [None] * C
+        fails_per: List = [None] * C
 
         def decode_into(b, gathered):
             with spans.span(f"exchange/bucket/{self.specs[b].label}"):
-                totals[b], owns[b] = self._decode_bucket(
-                    self.specs[b], gathered, num_workers, step, need_own=need_own
+                totals[b], owns[b], fails_per[b] = self._decode_bucket(
+                    self.specs[b],
+                    gathered,
+                    num_workers,
+                    step,
+                    need_own=need_own,
+                    row_weights=row_weights,
                 )
 
         if self.cfg.bucket_pipeline and C > 0:
@@ -300,10 +360,17 @@ class BucketedExchanger:
             for b in range(C):
                 decode_into(b, gathered[b])
 
+        if self._checksum and collect is not None:
+            fails = jnp.zeros((), jnp.float32)
+            for f in fails_per:
+                fails = fails + f
+            collect["checksum_failures"] = fails
+
+        den = denom if denom is not None else num_workers
         agg_leaves: Dict[str, jax.Array] = {}
         own_leaves: Dict[str, jax.Array] = {}
         for b, spec in enumerate(self.specs):
-            agg_leaves.update(self.split_bucket(spec, totals[b] / num_workers))
+            agg_leaves.update(self.split_bucket(spec, totals[b] / den))
             if need_own:
                 own_leaves.update(self.split_bucket(spec, owns[b]))
         return agg_leaves, own_leaves, stats_per, payloads

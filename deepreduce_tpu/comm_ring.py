@@ -33,9 +33,10 @@ not a bitwise-replicated one. See ARCHITECTURE.md "Decode strategies".
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from deepreduce_tpu.telemetry import spans
 
@@ -51,6 +52,7 @@ def ring_decode_exchange(
     axis_name: str,
     num_workers: int,
     need_own: bool,
+    row_weights: Optional[jax.Array] = None,
 ) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...]]:
     """Ring-exchange the fused uint8 payload `buf` over `axis_name`,
     decoding and accumulating each arriving chunk.
@@ -60,12 +62,29 @@ def ring_decode_exchange(
     sum of all W workers' decodes, and the own-payload decode (empty tuple
     when `need_own` is False — it is still computed, as round 0 of the sum).
 
+    `row_weights` (f32[W], replicated, or None) is the participation mask:
+    the chunk arriving at round r originated at worker (me - r) mod W, and
+    its decode is scaled by that worker's weight before accumulation. The
+    own chunk is round 0, so a masked-out worker's own decode is zeroed —
+    exactly what residual error feedback needs to retain its un-sent mass.
+    When None, the traced program is unchanged.
+
     `num_workers` must be the concrete mesh-axis size (ppermute needs a
     static permutation).
     """
     W = int(num_workers)
+    if row_weights is not None:
+        widx = jax.lax.axis_index(axis_name)
+
+        def weight(decs, r):
+            src = jnp.remainder(widx - r, W)  # who round r's chunk came from
+            wgt = jax.lax.dynamic_index_in_dim(row_weights, src, keepdims=False)
+            return tuple(d * wgt for d in decs)
+
     with spans.span("exchange/ring"):
         own = decode_row(buf)
+        if row_weights is not None:
+            own = weight(own, 0)
         if W == 1:
             return own, (own if need_own else ())
 
@@ -78,14 +97,23 @@ def ring_decode_exchange(
 
         # rounds 1 .. W-2: issue hop i+1, then decode the chunk from round
         # i. The permute is issued first so its transfer has no dependence
-        # on the decode program and can run concurrently with it.
-        def body(_i, carry):
+        # on the decode program and can run concurrently with it. The mask
+        # weighting stays behind the None-gate so the mask-free trace is
+        # byte-identical to pre-resilience builds (no dead round-index
+        # arithmetic in the loop body).
+        def body(i, carry):
             acc, cur = carry
             nxt = send(cur)
-            acc = _tree_add(acc, decode_row(cur))
+            decs = decode_row(cur)
+            if row_weights is not None:
+                decs = weight(decs, i + 1)
+            acc = _tree_add(acc, decs)
             return acc, nxt
 
         acc, last = jax.lax.fori_loop(0, W - 2, body, (acc, nxt))
         # epilogue: the final chunk has nothing left to forward
-        acc = _tree_add(acc, decode_row(last))
+        last_decs = decode_row(last)
+        if row_weights is not None:
+            last_decs = weight(last_decs, W - 1)
+        acc = _tree_add(acc, last_decs)
     return acc, (own if need_own else ())

@@ -140,6 +140,34 @@ class DeepReduceConfig:
     # host fetch cadence for the accumulators (steps between device->host
     # syncs of the ten-scalar pytree); the hot loop itself never syncs
     telemetry_every: int = 10
+    # resilience subsystem (deepreduce_tpu.resilience): elastic
+    # participation + chaos injection + graceful degradation for the
+    # compressed exchange. Off by default — the resilience-off step program
+    # is byte-identical to a build without the subsystem (pinned by the
+    # jx-resilience-off-identical analysis rule and the retrace-hash test).
+    resilience: bool = False
+    # per-step PRNG worker dropout: each step, every worker is dropped from
+    # the exchange with this probability (the mask is derived from the
+    # step's shared key, so all workers agree on who is live). Dropped
+    # workers contribute zero payload; the mean renormalizes by live count
+    # and un-sent gradient mass stays in the dropped worker's residual.
+    drop_rate: float = 0.0
+    # deterministic fault schedule: comma-separated `worker@start:stop`
+    # (worker dropped for steps start <= t < stop) or `worker@step` (one
+    # step), e.g. "2@5:9,0@12". Composes with drop_rate (AND of both masks).
+    fault_plan: Optional[str] = None
+    # append a 4-byte checksum word to every PayloadLayout buffer and
+    # verify it on decode: a failed payload degrades to zero contribution
+    # plus a `checksum_failures` telemetry count instead of NaN. Requires
+    # the fused allgather exchange (the wire format that has a layout).
+    payload_checksum: bool = False
+    # chaos injector (resilience/chaos.py): deterministic per-(step,worker)
+    # wire-boundary perturbations of the packed payload, keyed from `seed`.
+    # All three require payload_checksum so the damage is detected and
+    # degraded instead of silently decoded.
+    chaos_drop_rate: float = 0.0      # P(whole payload zeroed — never arrives)
+    chaos_corrupt_rate: float = 0.0   # P(random bytes XOR-flipped)
+    chaos_truncate_rate: float = 0.0  # P(trailing half of the buffer zeroed)
 
     # the documented enumerations (comments above + codecs/registry.py).
     # __post_init__ checks against these so a typo like
@@ -189,6 +217,68 @@ class DeepReduceConfig:
                 "bucket_bytes must be >= 4 (one f32 element) or None, got "
                 f"{self.bucket_bytes}"
             )
+        # --- resilience surface: loud failure for silently-ignored knobs ---
+        for rate_name in (
+            "drop_rate", "chaos_drop_rate", "chaos_corrupt_rate",
+            "chaos_truncate_rate",
+        ):
+            rate = getattr(self, rate_name)
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(
+                    f"{rate_name} must be in [0, 1], got {rate}"
+                )
+        engaged = [
+            name
+            for name, default in (
+                ("drop_rate", 0.0),
+                ("fault_plan", None),
+                ("payload_checksum", False),
+                ("chaos_drop_rate", 0.0),
+                ("chaos_corrupt_rate", 0.0),
+                ("chaos_truncate_rate", 0.0),
+            )
+            if getattr(self, name) != default
+        ]
+        if engaged and not self.resilience:
+            raise ValueError(
+                f"{', '.join(engaged)} configure the resilience subsystem "
+                "and would be silently ignored with resilience=False — set "
+                "resilience=True (or drop the knob(s))"
+            )
+        if self.resilience and self.communicator not in ("allgather", "allreduce"):
+            raise ValueError(
+                "resilience=True threads a participation mask through the "
+                "exchange, which only the allgather/allreduce communicators "
+                f"support — communicator={self.communicator!r} would silently "
+                "ignore the mask"
+            )
+        chaos_on = (
+            self.chaos_drop_rate > 0
+            or self.chaos_corrupt_rate > 0
+            or self.chaos_truncate_rate > 0
+        )
+        if chaos_on and not self.payload_checksum:
+            raise ValueError(
+                "chaos_*_rate perturbs payloads at the wire boundary; without "
+                "payload_checksum=True the damage decodes silently (NaNs or "
+                "skewed means) instead of degrading to a counted zero "
+                "contribution — enable payload_checksum with chaos injection"
+            )
+        if self.payload_checksum and not (
+            self.fused and self.communicator == "allgather"
+        ):
+            raise ValueError(
+                "payload_checksum appends a checksum word to the fused "
+                "PayloadLayout wire format and would be silently ignored here "
+                f"(communicator={self.communicator!r}, fused={self.fused}) — "
+                "use fused=True with communicator='allgather'"
+            )
+        if self.fault_plan is not None:
+            # syntax check at construction (deferred import: faults.py is
+            # config-free, so no cycle)
+            from deepreduce_tpu.resilience.faults import FaultPlan
+
+            FaultPlan.parse(self.fault_plan)
 
     @classmethod
     def tpu_defaults(cls, **overrides) -> "DeepReduceConfig":

@@ -172,11 +172,29 @@ class FedAvg:
         return p_end
 
     def run_round(
-        self, state: FedAvgState, ids: jax.Array, client_batches: Any, key: jax.Array
+        self,
+        state: FedAvgState,
+        ids: jax.Array,
+        client_batches: Any,
+        key: jax.Array,
+        *,
+        participation: Optional[jax.Array] = None,
     ) -> Tuple[FedAvgState, Dict[str, Any]]:
         """One round. `ids` from `sample_clients`; `client_batches` leaves
-        are [clients_per_round, local_steps, ...] for exactly those ids."""
+        are [clients_per_round, local_steps, ...] for exactly those ids.
+
+        `participation` (bool[C] over the SAMPLED clients, or None) models
+        a sampled client failing to return its C2S update: a False
+        client's decoded update and wire bits are scaled to zero, the
+        server mean renormalizes by the live count, and the client's C2S
+        residual is left untouched (it never compressed, so there is no
+        new error to feed back — its pending mass waits for the next time
+        it is sampled). The S2C broadcast stays global: `w_ref` models
+        what every client *can* reconstruct from the broadcast stream.
+        With participation=None the traced round is unchanged."""
         C = self.fed.clients_per_round
+        has_part = participation is not None
+        part = participation.astype(jnp.float32) if has_part else None
         key_s2c, key_c2s = jax.random.split(key)
 
         # --- S2C: broadcast the compressed model delta -------------------
@@ -211,11 +229,10 @@ class FedAvg:
 
         def client_body(carry, xs):
             upd_sum, wire_acc = carry
-            if use_res:
-                c, batch_c, res_c = xs
-            else:
-                c, batch_c = xs
-                res_c = None
+            c, batch_c = xs[0], xs[1]
+            rest = xs[2:]
+            res_c = rest[0] if use_res else None
+            m = rest[-1] if has_part else None
             with spans.span("fedavg/local_train"):
                 p_end = self._local_train(
                     w_ref, batch_c, jax.random.fold_in(key_c2s, 2 * c)
@@ -226,6 +243,22 @@ class FedAvg:
                     "c2s", update, res_c, state.round,
                     jax.random.fold_in(key_c2s, 2 * c + 1),
                 )
+            if has_part:
+                # a non-participating client returns nothing: zero its
+                # decoded update and wire bits, and keep its residual as it
+                # was (no compression happened, no new error to feed back)
+                dec_upd = jax.tree_util.tree_map(lambda u: u * m, dec_upd)
+                if use_res:
+                    new_res_c = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(m > 0, new, old),
+                        new_res_c,
+                        res_c,
+                    )
+                wire_c = WireStats(
+                    index_bits=wire_c.index_bits * m,
+                    value_bits=wire_c.value_bits * m,
+                    dense_bits=wire_c.dense_bits * m,
+                )
             upd_sum = jax.tree_util.tree_map(jnp.add, upd_sum, dec_upd)
             wire_acc = WireStats(
                 index_bits=wire_acc.index_bits + wire_c.index_bits,
@@ -235,7 +268,11 @@ class FedAvg:
             return (upd_sum, wire_acc), (new_res_c if use_res else 0)
 
         cs = jnp.arange(C, dtype=jnp.uint32)
-        xs = (cs, client_batches, res_stack) if use_res else (cs, client_batches)
+        xs = (cs, client_batches)
+        if use_res:
+            xs = xs + (res_stack,)
+        if has_part:
+            xs = xs + (part,)
         with spans.span("fedavg/clients"):
             (upd_sum, wire_c2s), new_res_stack = jax.lax.scan(
                 client_body, (upd_sum0, wire0), xs
@@ -246,7 +283,11 @@ class FedAvg:
             )
         wires = [wire_s2c, wire_c2s]
 
-        mean_upd = jax.tree_util.tree_map(lambda s: s / C, upd_sum)
+        if has_part:
+            live = jnp.maximum(jnp.sum(part), 1.0)
+            mean_upd = jax.tree_util.tree_map(lambda s: s / live, upd_sum)
+        else:
+            mean_upd = jax.tree_util.tree_map(lambda s: s / C, upd_sum)
         new_params = jax.tree_util.tree_map(
             lambda w, u: w + self.fed.server_lr * u, state.params, mean_upd
         )

@@ -1,0 +1,24 @@
+"""Resilience subsystem: elastic participation, chaos injection, retry.
+
+Three layers, each independently gated so resilience-off programs trace to
+a byte-identical jaxpr (pinned by the `jx-resilience-off-identical`
+analysis rule):
+
+- `faults` — participation masks (FaultPlan schedules + PRNG dropout)
+  threaded through the jitted step; dropped workers keep their residual
+  EF accumulator so un-sent mass re-delivers on rejoin;
+- `chaos` — deterministic payload perturbation at the wire boundary,
+  detected by the `PayloadLayout` checksum word and degraded to a zero
+  contribution plus a `checksum_failures` telemetry counter;
+- `retry` — host-side exponential backoff for checkpoint/tracking I/O.
+
+Only `retry` is re-exported here: it is pure stdlib, and light importers
+(tracking.py) must not drag jax in transitively. Traced consumers import
+`faults`/`chaos` directly.
+"""
+
+from deepreduce_tpu.resilience.retry import (  # noqa: F401
+    DEFAULT_RETRY_ON,
+    retry_call,
+    retry_io,
+)

@@ -1,0 +1,92 @@
+"""Chaos injector: deterministic payload perturbation at the wire boundary.
+
+Exercises decode paths against hostile inputs *inside* the jitted step:
+after a worker packs its fused byte payload (and after the checksum word
+is appended), `ChaosInjector.perturb` may drop it (zero the whole buffer),
+bit-corrupt a random subset of bytes, or truncate its tail — each an
+independent Bernoulli draw per (step, worker, salt) from a PRNG stream
+keyed off `cfg.seed`, so every run of a given config injects the identical
+fault sequence and failures reproduce exactly.
+
+Perturbation happens strictly between pack and all_gather, so the decode
+side sees corrupt bytes exactly as a lossy transport would deliver them.
+With `payload_checksum=True` the receiver detects the damage, zeroes the
+contribution, and bumps the `checksum_failures` telemetry counter — the
+graceful-degradation path `make chaos-check` pins. All control flow is
+elementwise `jnp.where`; nothing branches on traced values on the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# domain-separation tag for the chaos PRNG stream (vs. dropout's 0x0FA17)
+_CHAOS_TAG = 0x0C405
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosInjector:
+    """Per-payload fault model: drop / bit-corrupt / truncate, each with an
+    independent per-(step, worker, salt) Bernoulli rate."""
+
+    drop_rate: float = 0.0
+    corrupt_rate: float = 0.0
+    truncate_rate: float = 0.0
+    seed: int = 0
+    # fraction of bytes XOR-flipped when a corrupt event fires: sparse
+    # enough that most of the payload stays plausible (the hard case for
+    # a decoder), dense enough the checksum always trips
+    corrupt_frac: float = 0.05
+
+    @classmethod
+    def from_config(cls, cfg) -> Optional["ChaosInjector"]:
+        """None (no wiring, byte-identical program) unless resilience is on
+        and at least one chaos rate is non-zero."""
+        if not getattr(cfg, "resilience", False):
+            return None
+        rates = (cfg.chaos_drop_rate, cfg.chaos_corrupt_rate, cfg.chaos_truncate_rate)
+        if all(r <= 0.0 for r in rates):
+            return None
+        return cls(
+            drop_rate=float(cfg.chaos_drop_rate),
+            corrupt_rate=float(cfg.chaos_corrupt_rate),
+            truncate_rate=float(cfg.chaos_truncate_rate),
+            seed=int(getattr(cfg, "seed", 0) or 0),
+        )
+
+    def perturb(self, buf: jax.Array, *, step, worker, salt: int = 0) -> jax.Array:
+        """Perturb a packed uint8 payload. `worker` may be traced
+        (axis_index); `salt` distinguishes multiple payloads per step
+        (bucket index) so buckets don't fail in lockstep."""
+        B = buf.shape[0]
+        if B == 0:
+            return buf
+        key = jax.random.PRNGKey(self.seed)
+        key = jax.random.fold_in(key, _CHAOS_TAG)
+        key = jax.random.fold_in(key, salt)
+        key = jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
+        key = jax.random.fold_in(key, worker)
+        kd, kc, kt, ksel, kbytes = jax.random.split(key, 5)
+
+        out = buf
+        if self.corrupt_rate > 0.0:
+            corrupt = jax.random.bernoulli(kc, self.corrupt_rate)
+            # minval=1: the XOR mask never degenerates to a no-op flip
+            noise = jax.random.randint(kbytes, (B,), 1, 256, jnp.uint8)
+            sel = jax.random.bernoulli(ksel, self.corrupt_frac, (B,))
+            out = jnp.where(corrupt & sel, out ^ noise, out)
+        if self.truncate_rate > 0.0:
+            trunc = jax.random.bernoulli(kt, self.truncate_rate)
+            tail = jnp.arange(B) >= B // 2
+            out = jnp.where(trunc & tail, jnp.uint8(0), out)
+        if self.drop_rate > 0.0:
+            # drop last: a dropped payload is all-zero regardless of what
+            # corrupt/truncate did (the XOR-salted checksum still trips —
+            # an all-zero buffer never matches its zeroed checksum word)
+            drop = jax.random.bernoulli(kd, self.drop_rate)
+            out = jnp.where(drop, jnp.zeros_like(out), out)
+        return out

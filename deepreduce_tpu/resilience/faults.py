@@ -1,0 +1,119 @@
+"""Participation faults: deterministic FaultPlan schedules + PRNG dropout.
+
+The elastic-participation half of the resilience subsystem. A *participation
+mask* is a traced bool[W] vector over the mesh's data axis: True = the
+worker's payload enters this step's aggregate, False = it contributes zero
+and the mean renormalizes by the live count. Both sources are deterministic
+functions of (config, step, key), computed identically on every worker from
+replicated inputs — no coordination, no host control flow (the
+ast-mask-host-branch lint rule pins that):
+
+- `FaultPlan` — an explicit schedule parsed from a spec string like
+  ``"2@5:9,0@12"`` (worker 2 dropped for steps 5..8, worker 0 at step 12),
+  the reproducible-failure harness the chaos CLI and tests drive;
+- PRNG dropout — each worker dropped i.i.d. with `drop_rate` per step,
+  keyed from the step's *shared* key (never the worker-folded one), so the
+  mask is replicated by construction.
+
+Dropped workers keep their residual error-feedback accumulator: the
+exchange scales their own-payload decode to zero, so `memory.update`
+(residual' = compensated - own_decode) retains the whole compensated
+gradient — un-sent mass re-delivers on rejoin through the EF telescoping
+identity. See ARCHITECTURE.md "Resilience".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# `worker@step` or `worker@start:stop`
+_ENTRY_RE = re.compile(r"^\s*(\d+)\s*@\s*(\d+)\s*(?::\s*(\d+)\s*)?$")
+
+# domain-separation tag so the dropout stream never collides with other
+# fold_in consumers of the step key
+_DROPOUT_TAG = 0x0FA17
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Static drop schedule: (worker, start, stop) triples, dropped for
+    steps ``start <= t < stop``. Parsed once at config validation; the
+    traced mask is a pure elementwise function of the step counter."""
+
+    entries: Tuple[Tuple[int, int, int], ...]
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        if not isinstance(spec, str) or not spec.strip():
+            raise ValueError(
+                f"fault_plan must be a non-empty spec string like "
+                f"'2@5:9,0@12', got {spec!r}"
+            )
+        entries = []
+        for part in spec.split(","):
+            m = _ENTRY_RE.match(part)
+            if m is None:
+                raise ValueError(
+                    f"bad fault_plan entry {part.strip()!r} — expected "
+                    "'worker@step' or 'worker@start:stop'"
+                )
+            worker, start = int(m.group(1)), int(m.group(2))
+            stop = int(m.group(3)) if m.group(3) is not None else start + 1
+            if stop <= start:
+                raise ValueError(
+                    f"fault_plan entry {part.strip()!r} has empty range "
+                    f"[{start}, {stop})"
+                )
+            entries.append((worker, start, stop))
+        return cls(entries=tuple(entries))
+
+    def mask(self, step, num_workers: int) -> jax.Array:
+        """Traced bool[W]: True = live at `step`. Entries whose worker id
+        exceeds the mesh width are ignored (mode='drop' scatter)."""
+        W = int(num_workers)
+        if not self.entries:
+            return jnp.ones((W,), jnp.bool_)
+        workers = jnp.asarray(np.array([e[0] for e in self.entries]), jnp.int32)
+        starts = jnp.asarray(np.array([e[1] for e in self.entries]), jnp.int32)
+        stops = jnp.asarray(np.array([e[2] for e in self.entries]), jnp.int32)
+        s = jnp.asarray(step, jnp.int32)
+        hit = ((s >= starts) & (s < stops)).astype(jnp.int32)  # [E]
+        dropped = (
+            jnp.zeros((W,), jnp.int32).at[workers].max(hit, mode="drop")
+        )
+        return dropped == 0
+
+
+def participation_mask(
+    num_workers: int,
+    step,
+    key: Optional[jax.Array],
+    *,
+    drop_rate: float = 0.0,
+    fault_plan: Optional[str] = None,
+) -> Optional[jax.Array]:
+    """The per-step mask the trainer threads into `exchange`: AND of the
+    FaultPlan schedule and the PRNG dropout. Returns None when neither
+    source is configured, so a resilience-on-but-drop-free program carries
+    no mask arithmetic at all (chaos injection composes independently)."""
+    if drop_rate <= 0.0 and fault_plan is None:
+        return None
+    W = int(num_workers)
+    mask = jnp.ones((W,), jnp.bool_)
+    if fault_plan is not None:
+        mask = mask & FaultPlan.parse(fault_plan).mask(step, W)
+    if drop_rate > 0.0:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        # keyed from the SHARED step key + step counter (never the
+        # worker-folded key): every worker derives the identical mask
+        k = jax.random.fold_in(key, _DROPOUT_TAG)
+        k = jax.random.fold_in(k, jnp.asarray(step, jnp.uint32))
+        mask = mask & jax.random.bernoulli(k, 1.0 - float(drop_rate), (W,))
+    return mask

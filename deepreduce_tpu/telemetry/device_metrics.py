@@ -45,6 +45,9 @@ class MetricAccumulators:
     err_cos: jax.Array        # Σ per-step cos(agg, dense_mean)
     fp_count: jax.Array       # Σ bloom false positives (decoded-but-not-selected)
     fp_universe: jax.Array    # Σ not-selected universe size (FPR denominator)
+    live_workers: jax.Array   # Σ per-step live-worker count (participation)
+    dropped_steps: jax.Array  # steps where ≥1 worker was masked out
+    checksum_failures: jax.Array  # Σ failed-checksum payload decodes
     # Σ per-BUCKET saturation counts, f32[C] in bucket-spec order for the
     # bucketed exchange (f32[0] when unbucketed) — keeps one chronically
     # overfull bucket visible next to the summed `saturated` total
@@ -70,6 +73,9 @@ class MetricAccumulators:
         err_cos=0.0,
         fp_count=0.0,
         fp_universe=0.0,
+        live_workers=0.0,
+        dropped_steps=0.0,
+        checksum_failures=0.0,
         bucket_saturated=0.0,
     ) -> "MetricAccumulators":
         f = lambda x: jnp.asarray(x, jnp.float32)
@@ -84,6 +90,9 @@ class MetricAccumulators:
             err_cos=self.err_cos + f(err_cos),
             fp_count=self.fp_count + f(fp_count),
             fp_universe=self.fp_universe + f(fp_universe),
+            live_workers=self.live_workers + f(live_workers),
+            dropped_steps=self.dropped_steps + f(dropped_steps),
+            checksum_failures=self.checksum_failures + f(checksum_failures),
             # broadcasts: [C] + [C] per-step vector, or [C] + 0.0 when the
             # caller has nothing to report this step (and [0] + 0.0 when
             # unbucketed — a no-op on the empty vector)
@@ -129,4 +138,9 @@ class MetricAccumulators:
             "compress_err_l2": vals["err_l2"] / steps,
             "compress_err_cos": vals["err_cos"] / steps,
             "measured_fpr": vals["fp_count"] / max(vals["fp_universe"], 1.0),
+            # resilience counters: mean live workers per step, total steps
+            # with ≥1 masked worker, total failed-checksum payload decodes
+            "live_workers_per_step": vals["live_workers"] / steps,
+            "dropped_steps": vals["dropped_steps"],
+            "checksum_failures": vals["checksum_failures"],
         }

@@ -23,6 +23,8 @@ import pathlib
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from deepreduce_tpu.resilience.retry import retry_io
+
 _RUN_SEQ = itertools.count()  # disambiguates unnamed runs within one second
 
 
@@ -60,14 +62,18 @@ class Run:
         self.name = name
         self.dir = pathlib.Path(root) / self.name
         self.dir.mkdir(parents=True, exist_ok=True)
-        self._metrics = open(self.dir / "metrics.jsonl", "a")
+        self._metrics = retry_io(lambda: open(self.dir / "metrics.jsonl", "a"))
         self._step = 0
-        with open(self.dir / "config.json", "w") as f:
-            json.dump(
-                {"name": self.name, "tags": list(tags or []), "config": _jsonable(config or {})},
-                f,
-                indent=2,
-            )
+
+        def _write_config():
+            with open(self.dir / "config.json", "w") as f:
+                json.dump(
+                    {"name": self.name, "tags": list(tags or []), "config": _jsonable(config or {})},
+                    f,
+                    indent=2,
+                )
+
+        retry_io(_write_config)
 
     def log(self, metrics: Dict[str, Any], step: Optional[int] = None) -> None:
         if step is None:
@@ -81,12 +87,22 @@ class Run:
             for k, v in _jsonable(metrics).items()
         }
         rec.update(user)
-        self._metrics.write(json.dumps(rec) + "\n")
-        self._metrics.flush()
+
+        def _append():
+            # retried as a unit: if the write lands but the flush raises, a
+            # retry may duplicate the line — history() consumers key on
+            # `step`, so a dup is harmless where a lost record is not
+            self._metrics.write(json.dumps(rec) + "\n")
+            self._metrics.flush()
+
+        retry_io(_append)
 
     def finish(self, summary: Optional[Dict[str, Any]] = None) -> None:
-        with open(self.dir / "summary.json", "w") as f:
-            json.dump(_jsonable(summary or {}), f, indent=2)
+        def _write_summary():
+            with open(self.dir / "summary.json", "w") as f:
+                json.dump(_jsonable(summary or {}), f, indent=2)
+
+        retry_io(_write_summary)
         self._metrics.close()
 
     def __enter__(self) -> "Run":

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from deepreduce_tpu.comm import GradientExchanger
 from deepreduce_tpu.config import DeepReduceConfig
 from deepreduce_tpu.metrics import WireStats
+from deepreduce_tpu.resilience import faults
 from deepreduce_tpu.telemetry import MetricAccumulators, spans
 
 
@@ -77,6 +78,18 @@ def make_worker_step(
     quantities are collective-reduced on device, so the accumulator stays
     replicated and the hot loop never syncs to host."""
     axis = exchanger.axis_name
+    cfg = exchanger.cfg
+    # Python-level gate (like `if telemetry:` below): the resilience-off
+    # step is built from the identical source path with no mask arithmetic,
+    # so its jaxpr is byte-identical to a pre-resilience build (pinned by
+    # tests/test_resilience.py + the jx-resilience-off-identical rule)
+    resilient = bool(cfg.resilience)
+    if resilient and (cfg.drop_rate > 0.0 or cfg.fault_plan is not None):
+        if exchanger.num_workers is None:
+            raise ValueError(
+                "participation masks need the static mesh size: construct "
+                "GradientExchanger(..., num_workers=mesh.shape[axis])"
+            )
 
     def step_fn(state: TrainState, batch, key: jax.Array, acc=None):
         with spans.span("train/forward_backward"):
@@ -87,10 +100,27 @@ def make_worker_step(
         if new_stats:
             new_stats = jax.lax.pmean(new_stats, axis)
 
+        mask = None
+        if resilient:
+            with spans.span("resilience/mask"):
+                # derived from the SHARED step key (pre worker fold_in), so
+                # every worker computes the identical replicated mask
+                mask = faults.participation_mask(
+                    exchanger.num_workers,
+                    state.step,
+                    key,
+                    drop_rate=cfg.drop_rate,
+                    fault_plan=cfg.fault_plan,
+                )
         collect = {} if telemetry else None
         with spans.span("train/exchange"):
             agg, new_residuals, wire = exchanger.exchange(
-                grads, state.residuals, step=state.step, key=key, collect=collect
+                grads,
+                state.residuals,
+                step=state.step,
+                key=key,
+                collect=collect,
+                mask=mask,
             )
         with spans.span("train/apply_updates"):
             updates, new_opt = optimizer.update(agg, state.opt_state, state.params)
@@ -139,6 +169,17 @@ def make_worker_step(
         # per-bucket saturation counts, f32[C] (only present when the
         # bucketed exchange ran); summed over workers like `saturated`
         bucket_sat = collect.get("bucket_saturated")
+        # resilience counters: live worker count, whether any worker sat
+        # this step out, and checksum failures over gathered rows (the
+        # failure count is replicated — every worker decodes the same
+        # gathered buffer — so no psum)
+        total_w = jnp.asarray(jax.lax.psum(1, axis), jnp.float32)
+        if mask is not None:
+            live = jnp.sum(mask.astype(jnp.float32))
+            dropped = (live < total_w).astype(jnp.float32)
+        else:
+            live = total_w
+            dropped = jnp.zeros((), jnp.float32)
         new_acc = acc.accumulate(
             wire_mean,
             residual_l2=residual_l2,
@@ -146,6 +187,9 @@ def make_worker_step(
             err_cos=err_cos,
             fp_count=jax.lax.psum(collect["fp_count"], axis),
             fp_universe=jax.lax.psum(collect["fp_universe"], axis),
+            live_workers=live,
+            dropped_steps=dropped,
+            checksum_failures=collect.get("checksum_failures", 0.0),
             bucket_saturated=(
                 jax.lax.psum(bucket_sat, axis) if bucket_sat is not None else 0.0
             ),

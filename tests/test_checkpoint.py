@@ -53,3 +53,128 @@ def test_common_init_round_trip(tmp_path):
     loaded = checkpoint.load_common_init(path, params)
     for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(loaded)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------- #
+# config fingerprint (resilience: fail-fast restore mismatch)
+# ---------------------------------------------------------------------- #
+
+
+def _cfg(**kw):
+    base = dict(deepreduce=None, compress_ratio=0.25, memory="residual")
+    base.update(kw)
+    return DeepReduceConfig(**base)
+
+
+def test_config_fingerprint_semantics():
+    assert checkpoint.config_fingerprint(_cfg()) == checkpoint.config_fingerprint(_cfg())
+    # codec-bearing fields change the fingerprint
+    assert checkpoint.config_fingerprint(_cfg()) != checkpoint.config_fingerprint(
+        _cfg(compress_ratio=0.5)
+    )
+    # observability-only knobs do not — a telemetry toggle never blocks resume
+    assert checkpoint.config_fingerprint(_cfg()) == checkpoint.config_fingerprint(
+        _cfg(telemetry=True)
+    )
+
+
+def test_restore_fails_fast_on_config_mismatch(tmp_path):
+    mesh = shared_mesh(2)
+    trainer = Trainer(Tiny(), _cfg(), optax.sgd(0.1), mesh)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 4, size=16), jnp.int32)
+    state = trainer.init_state(jax.random.PRNGKey(0), (x, y))
+
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, state, config=_cfg())
+    # the stamp is a sibling file, outside the orbax-owned directory
+    assert (tmp_path / "ckpt.config.json").exists()
+
+    template = trainer.init_state(jax.random.PRNGKey(0), (x, y))
+    restored = checkpoint.restore(path, template, config=_cfg())  # same cfg: ok
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(restored)[0]),
+        np.asarray(jax.tree_util.tree_leaves(state)[0]),
+    )
+    with pytest.raises(ValueError, match="fingerprint"):
+        checkpoint.restore(path, template, config=_cfg(compress_ratio=0.5))
+    # a legacy checkpoint without a stamp restores under any config
+    (tmp_path / "ckpt.config.json").unlink()
+    checkpoint.restore(path, template, config=_cfg(compress_ratio=0.5))
+
+
+# ---------------------------------------------------------------------- #
+# kill / resume through the benchmark driver
+# ---------------------------------------------------------------------- #
+
+
+def _bench_args(**kw):
+    import argparse
+
+    base = dict(
+        model="mlp",
+        grace_config=(
+            "{'compressor':'topk','compress_ratio':0.25,'deepreduce':None,"
+            "'memory':'residual','min_compress_size':16}"
+        ),
+        num_steps=6, batch_size=32, num_workers=4, learning_rate=0.1, seed=0,
+        log_every=0, track_dir="", run_name="", tags="", telemetry=True,
+        profile_dir="", checkpoint_every=0, checkpoint_dir="", resume=False,
+        platform="",
+    )
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+def _bench_module():
+    import importlib.util
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    spec = importlib.util.spec_from_file_location(
+        "bench_train", root / "benchmarks" / "train.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_kill_and_resume_continues_exactly(tmp_path):
+    """A run checkpointed at step 4 and resumed to step 6 must land on the
+    same loss as an uninterrupted 6-step run: batches are a pure function
+    of (seed, step) and the checkpoint carries params, optimizer state,
+    residual EF memory, step counter AND the telemetry accumulator."""
+    from deepreduce_tpu.telemetry import spans
+
+    bench = _bench_module()
+    ck = str(tmp_path / "ck")
+
+    try:
+        full = bench.run(_bench_args(num_steps=6))
+
+        killed = bench.run(_bench_args(num_steps=4, checkpoint_every=2,
+                                       checkpoint_dir=ck))
+        assert killed["steps"] == 4
+        resumed = bench.run(_bench_args(num_steps=6, checkpoint_dir=ck,
+                                        resume=True))
+        assert resumed["resumed_at"] == 4
+        # the resumed tail reproduces the uninterrupted run exactly
+        np.testing.assert_allclose(resumed["last_loss"], full["last_loss"],
+                                   rtol=1e-6)
+        # telemetry accumulator resumed too: counts all 6 steps, not just 2
+        assert resumed["telemetry"]["steps"] == 6.0
+        # resuming with a different codec config fails fast
+        with pytest.raises(ValueError, match="fingerprint"):
+            bench.run(_bench_args(
+                num_steps=6, checkpoint_dir=ck, resume=True,
+                grace_config=(
+                    "{'compressor':'topk','compress_ratio':0.5,"
+                    "'deepreduce':None,'memory':'residual',"
+                    "'min_compress_size':16}"
+                ),
+            ))
+    finally:
+        # run() enables the process-global tracer for telemetry runs;
+        # don't leak that into later tests
+        spans.configure(enabled=False, reset=True)

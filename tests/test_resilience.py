@@ -1,0 +1,538 @@
+"""Resilience subsystem tests: elastic participation, chaos injection, and
+graceful degradation of the compressed exchange.
+
+Pinned contracts:
+
+- all-ones participation mask is BITWISE identical to no mask, for every
+  decode strategy (loop/vmap/ring), the bucketed path, the per-tensor path
+  and the dense allreduce baseline;
+- a dropped worker keeps its un-sent gradient mass in the residual EF
+  accumulator and re-delivers it on rejoin (exact, on a lossless codec);
+- a corrupted payload fails its checksum and degrades to an exact-zero
+  contribution (params stay finite) while `checksum_failures` counts it;
+- resilience off is zero-cost: the trainer step traces to the identical
+  jaxpr with every resilience seam replaced by a raiser (never called);
+- host-side retry backs off deterministically;
+- the analysis gate's new rules fire on negative fixtures.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from conftest import shared_mesh
+from deepreduce_tpu import FedAvg, FedConfig
+from deepreduce_tpu.analysis.ast_lint import R_AST_MASK, lint_source
+from deepreduce_tpu.analysis.jaxpr_audit import check_off_identical
+from deepreduce_tpu.analysis.rules import R_RESILIENCE_OFF, jaxpr_hash
+from deepreduce_tpu.comm import GradientExchanger, PayloadLayout
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.resilience import chaos, faults, retry
+from deepreduce_tpu.train import Trainer
+from deepreduce_tpu.utils.compat import shard_map
+
+from test_train import TinyMLP, _data
+
+W, D = 8, 2048
+
+BLOOM_CFG = dict(
+    deepreduce="index", index="bloom", compress_ratio=0.05, fpr=0.01,
+    bloom_blocked="mod", policy="p0", memory="residual", min_compress_size=100,
+)
+
+
+# ---------------------------------------------------------------------- #
+# FaultPlan + participation_mask
+# ---------------------------------------------------------------------- #
+
+
+def test_fault_plan_parse():
+    plan = faults.FaultPlan.parse("2@5:9, 0@12")
+    assert plan.entries == ((2, 5, 9), (0, 12, 13))
+
+
+@pytest.mark.parametrize("bad", ["", "   ", "2@", "x@3", "1@5:5", "1@7:3", "2@5;9"])
+def test_fault_plan_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse(bad)
+
+
+def test_fault_plan_mask_schedule():
+    plan = faults.FaultPlan.parse("2@5:9,0@12")
+    for step, dropped in [(4, set()), (5, {2}), (8, {2}), (9, set()),
+                          (12, {0}), (13, set())]:
+        m = np.asarray(plan.mask(step, 4))
+        assert set(np.where(~m)[0].tolist()) == dropped, (step, m)
+
+
+def test_fault_plan_mask_ignores_out_of_range_workers():
+    # a plan written for an 8-way mesh still traces on a 4-way one
+    m = np.asarray(faults.FaultPlan.parse("6@0:100").mask(3, 4))
+    assert m.all()
+
+
+def test_participation_mask_none_when_unconfigured():
+    assert faults.participation_mask(8, 0, jax.random.PRNGKey(0)) is None
+
+
+def test_participation_mask_deterministic_and_composed():
+    key = jax.random.PRNGKey(7)
+    kw = dict(drop_rate=0.5, fault_plan="1@3")
+    m1 = np.asarray(faults.participation_mask(8, 3, key, **kw))
+    m2 = np.asarray(faults.participation_mask(8, 3, key, **kw))
+    np.testing.assert_array_equal(m1, m2)  # replicated by construction
+    assert not m1[1]  # the plan drop survives the AND with PRNG dropout
+    # pure-plan mask at a non-plan step is all ones
+    m3 = np.asarray(faults.participation_mask(8, 0, key, fault_plan="1@3"))
+    assert m3.all()
+
+
+# ---------------------------------------------------------------------- #
+# chaos injector + payload checksum units
+# ---------------------------------------------------------------------- #
+
+
+def _chaos(**kw):
+    base = dict(drop_rate=0.0, corrupt_rate=0.0, truncate_rate=0.0, seed=0)
+    base.update(kw)
+    return chaos.ChaosInjector(**base)
+
+
+def test_chaos_deterministic_and_modes():
+    buf = jnp.asarray(np.arange(1, 65, dtype=np.uint8))
+    drop = _chaos(drop_rate=1.0).perturb(buf, step=3, worker=2)
+    assert np.asarray(drop).sum() == 0  # whole payload "never arrives"
+    trunc = np.asarray(_chaos(truncate_rate=1.0).perturb(buf, step=3, worker=2))
+    assert (trunc[32:] == 0).all() and (trunc[:32] == np.arange(1, 33)).all()
+    inj = _chaos(corrupt_rate=1.0, corrupt_frac=0.5)
+    c1 = np.asarray(inj.perturb(buf, step=3, worker=2))
+    c2 = np.asarray(inj.perturb(buf, step=3, worker=2))
+    np.testing.assert_array_equal(c1, c2)  # same (step, worker) -> same damage
+    assert (c1 != np.asarray(buf)).any()
+    c3 = np.asarray(inj.perturb(buf, step=4, worker=2))
+    assert (c3 != c1).any()  # damage varies with the step
+
+
+def test_chaos_from_config_gating():
+    assert chaos.ChaosInjector.from_config(DeepReduceConfig(**BLOOM_CFG)) is None
+    cfg = DeepReduceConfig(resilience=True, payload_checksum=True,
+                           chaos_corrupt_rate=0.1, **BLOOM_CFG)
+    inj = chaos.ChaosInjector.from_config(cfg)
+    assert inj is not None and inj.corrupt_rate == 0.1
+
+
+def test_payload_layout_checksum():
+    sds = {"v": jax.ShapeDtypeStruct((16,), jnp.float32)}
+    layout = PayloadLayout(sds, checksum=True)
+    assert layout.nbytes == layout.payload_nbytes + 4 == 68
+    payload = {"v": jnp.arange(16, dtype=jnp.float32)}
+    buf = layout.pack(payload)
+    assert buf.shape == (68,)
+    np.testing.assert_array_equal(
+        np.asarray(layout.unpack(buf)["v"]), np.asarray(payload["v"])
+    )
+    assert float(layout.verify(buf)) == 1.0
+    corrupt = buf.at[5].set(buf[5] ^ np.uint8(0xFF))
+    assert float(layout.verify(corrupt)) == 0.0
+    # the XOR salt makes a fully-zeroed buffer fail its own zeroed word, so
+    # a chaos 'drop' is detected too
+    assert float(layout.verify(jnp.zeros_like(buf))) == 0.0
+    # checksum off: wire footprint unchanged, verify is constant truth
+    plain = PayloadLayout(sds)
+    assert plain.nbytes == plain.payload_nbytes == 64
+    assert float(plain.verify(plain.pack(payload))) == 1.0
+
+
+# ---------------------------------------------------------------------- #
+# masked exchange: all-ones identity + EF re-delivery
+# ---------------------------------------------------------------------- #
+
+
+def _grads(seed=0, n=W, d=D):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        (rng.normal(size=(n, d)) * rng.random((n, d)) ** 2).astype(np.float32)
+    )
+
+
+def _exchange_once(cfg_kwargs, grads_w, mask=None, step=0):
+    """One jitted shard_map'd exchange; returns (agg, residual) as numpy
+    pytrees (residual None when cfg has no memory)."""
+    tmap = jax.tree_util.tree_map
+    cfg = DeepReduceConfig(**cfg_kwargs)
+    n = jax.tree_util.tree_leaves(grads_w)[0].shape[0]
+    sds = tmap(lambda g: jax.ShapeDtypeStruct(g.shape[1:], jnp.float32), grads_w)
+    ex = GradientExchanger(sds, cfg, num_workers=n)
+    res0 = ex.init_state(tmap(lambda g: jnp.zeros(g.shape[1:], jnp.float32), grads_w))
+    if res0 is not None:
+        res0 = tmap(lambda r: jnp.broadcast_to(r[None], (n,) + r.shape), res0)
+    res_spec = P() if res0 is None else P("data")
+
+    if mask is None:
+
+        def spmd(g, res):
+            r0 = None if res is None else tmap(lambda r: r[0], res)
+            agg, new_res, _ = ex.exchange(tmap(lambda x: x[0], g), r0, step=step)
+            if new_res is not None:
+                new_res = tmap(lambda r: r[None], new_res)
+            return tmap(lambda x: x[None], agg), new_res
+
+        fn = shard_map(spmd, mesh=shared_mesh(n), in_specs=(P("data"), res_spec),
+                       out_specs=(P("data"), res_spec), check_vma=False)
+        agg, res = jax.jit(fn)(grads_w, res0)
+    else:
+
+        def spmd(g, res, m):
+            r0 = None if res is None else tmap(lambda r: r[0], res)
+            agg, new_res, _ = ex.exchange(
+                tmap(lambda x: x[0], g), r0, step=step, mask=m
+            )
+            if new_res is not None:
+                new_res = tmap(lambda r: r[None], new_res)
+            return tmap(lambda x: x[None], agg), new_res
+
+        fn = shard_map(spmd, mesh=shared_mesh(n),
+                       in_specs=(P("data"), res_spec, P()),
+                       out_specs=(P("data"), res_spec), check_vma=False)
+        agg, res = jax.jit(fn)(grads_w, res0, jnp.asarray(mask))
+    to_np = lambda t: None if t is None else tmap(np.asarray, t)
+    return to_np(agg), to_np(res)
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        {"decode_strategy": "loop"},
+        {"decode_strategy": "vmap", "decode_batch": 4},
+        {"decode_strategy": "ring"},
+    ],
+    ids=["loop", "vmap", "ring"],
+)
+def test_all_ones_mask_bitwise_identical_fused(extra):
+    g = _grads()
+    base, base_res = _exchange_once({**BLOOM_CFG, **extra}, g)
+    ones, ones_res = _exchange_once(
+        {**BLOOM_CFG, **extra}, g, mask=np.ones(W, bool)
+    )
+    np.testing.assert_array_equal(base, ones)
+    np.testing.assert_array_equal(
+        jax.tree_util.tree_leaves(base_res)[0], jax.tree_util.tree_leaves(ones_res)[0]
+    )
+
+
+def test_all_ones_mask_bitwise_identical_bucketed():
+    rng = np.random.default_rng(3)
+    g = {
+        "a": jnp.asarray(rng.normal(size=(W, 1500)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(W, 600)).astype(np.float32)),
+    }
+    kw = {**BLOOM_CFG, "bucket_bytes": 4000}
+    base, _ = _exchange_once(kw, g)
+    ones, _ = _exchange_once(kw, g, mask=np.ones(W, bool))
+    for k in base:
+        np.testing.assert_array_equal(base[k], ones[k])
+
+
+def test_all_ones_mask_bitwise_identical_per_tensor_and_dense():
+    g = _grads(d=512)
+    pt = {**BLOOM_CFG, "fused": False, "memory": "none"}
+    np.testing.assert_array_equal(
+        _exchange_once(pt, g)[0], _exchange_once(pt, g, mask=np.ones(W, bool))[0]
+    )
+    dense = dict(communicator="allreduce", compressor="none", deepreduce=None,
+                 memory="none")
+    np.testing.assert_array_equal(
+        _exchange_once(dense, g)[0],
+        _exchange_once(dense, g, mask=np.ones(W, bool))[0],
+    )
+
+
+def test_dropped_worker_mass_redelivers_through_residual():
+    """On a lossless codec (top-k at ratio 1.0): dropping worker 0 moves
+    its ENTIRE gradient into its residual, the masked mean renormalizes by
+    the live count, and the next (all-live) step re-delivers the held mass
+    exactly — the EF telescoping identity under elastic participation."""
+    lossless = dict(compressor="topk", compress_ratio=1.0, deepreduce=None,
+                    memory="residual", min_compress_size=1)
+    g = _grads(d=256)
+    gn = np.asarray(g)
+    mask = np.ones(W, bool)
+    mask[0] = False
+
+    agg1, res1 = _exchange_once(lossless, g, mask=mask)
+    # live workers decode losslessly -> zero residual; the dropped worker
+    # holds its whole compensated gradient
+    np.testing.assert_allclose(res1[0], gn[0], rtol=1e-6)
+    assert np.abs(res1[1:]).max() < 1e-5
+    np.testing.assert_allclose(
+        agg1[0], gn[1:].sum(axis=0) / 7.0, rtol=1e-5, atol=1e-6
+    )
+
+    # rejoin: feed the held residual back as EF state, no mask this time
+    cfg = DeepReduceConfig(**lossless)
+    ex = GradientExchanger(
+        jax.ShapeDtypeStruct((256,), jnp.float32), cfg, num_workers=W
+    )
+
+    def spmd(gw, res):
+        agg, new_res, _ = ex.exchange(gw[0], res[0], step=1)
+        return agg[None], new_res[None]
+
+    fn = shard_map(spmd, mesh=shared_mesh(W), in_specs=(P("data"), P("data")),
+                   out_specs=(P("data"), P("data")), check_vma=False)
+    agg2, res2 = jax.jit(fn)(g, jnp.asarray(res1))
+    # worker 0 ships g0 + held g0; every aggregate row sees the extra mass
+    np.testing.assert_allclose(
+        np.asarray(agg2)[0], (gn.sum(axis=0) + gn[0]) / 8.0, rtol=1e-5, atol=1e-6
+    )
+    assert np.abs(np.asarray(res2)).max() < 1e-5  # nothing left pending
+
+
+# ---------------------------------------------------------------------- #
+# trainer-level: drop schedule + chaos, telemetry counters, zero-cost-off
+# ---------------------------------------------------------------------- #
+
+
+def _trainer(cfg, n=4):
+    return Trainer(TinyMLP(), cfg, optax.sgd(0.1, momentum=0.9), shared_mesh(n))
+
+
+def test_train_under_drop_schedule_and_corruption():
+    """20 steps on the 4-way mesh with a deterministic drop schedule AND
+    20%-per-payload wire corruption: loss stays finite and decreases, the
+    dropped-step count matches the plan exactly, and every corrupted
+    payload lands in checksum_failures instead of the params."""
+    cfg = DeepReduceConfig(
+        telemetry=True, resilience=True, fault_plan="2@3:6,0@8:10",
+        payload_checksum=True, chaos_corrupt_rate=0.2, **BLOOM_CFG
+    )
+    trainer = _trainer(cfg)
+    x, y = _data(n=256)
+    state = trainer.init_state(jax.random.PRNGKey(0), (x[:64], y[:64]))
+    key = jax.random.PRNGKey(1)
+    losses = []
+    for step in range(20):
+        lo = (step * 64) % 192
+        state, loss, _ = trainer.step(
+            state, (x[lo:lo + 64], y[lo:lo + 64]), jax.random.fold_in(key, step)
+        )
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    summary = trainer.telemetry_summary()
+    assert summary["dropped_steps"] == 5.0  # steps 3,4,5 + 8,9
+    assert summary["checksum_failures"] > 0.0
+    assert summary["live_workers_per_step"] < 4.0
+
+
+def test_resilience_off_step_traces_identically(monkeypatch):
+    """cfg.resilience=False must cost literally nothing: the step program
+    hashes identically when every resilience seam is replaced by a raiser
+    — i.e. the disabled program never even reaches the subsystem."""
+    cfg = DeepReduceConfig(telemetry=False, **BLOOM_CFG)
+
+    def _hash():
+        import dataclasses
+
+        trainer = _trainer(cfg)
+        x, y = _data(n=64)
+        state = trainer.init_state(jax.random.PRNGKey(0), (x[:32], y[:32]))
+        trainer._build(state.residuals is not None)
+        state_nores = dataclasses.replace(state, residuals=None)
+        closed = jax.make_jaxpr(trainer._raw_step_fn)(
+            state_nores, state.residuals, (x[:32], y[:32]), jax.random.PRNGKey(1)
+        )
+        return jaxpr_hash(closed)
+
+    h_off = _hash()
+
+    def _boom(*a, **kw):
+        raise AssertionError("resilience seam reached with resilience off")
+
+    monkeypatch.setattr(faults, "participation_mask", _boom)
+    monkeypatch.setattr(chaos.ChaosInjector, "perturb", _boom)
+    monkeypatch.setattr(PayloadLayout, "verify", _boom)
+    assert _hash() == h_off
+
+
+# ---------------------------------------------------------------------- #
+# fedavg participation
+# ---------------------------------------------------------------------- #
+
+_FED_CFG = DeepReduceConfig(
+    compressor="topk", compress_ratio=0.25, deepreduce="index", index="integer",
+    policy="p0", memory="residual", min_compress_size=16,
+)
+
+
+def _fed_round(participation):
+    from test_fedavg import _problem
+
+    # clients_per_round is a power of two so the live-count division is
+    # exact whether XLA divides by the traced live count or the constant C
+    # — that keeps the all-ones assertion bitwise instead of 1-ulp fuzzy
+    _, batches_for, loss_fn, params = _problem(num_clients=6)
+    fed = FedConfig(num_clients=6, clients_per_round=4, local_steps=2)
+    fa = FedAvg(loss_fn, _FED_CFG, fed, optax.sgd(0.05))
+    state = fa.init(params)
+    key = jax.random.PRNGKey(11)
+    ids = fa.sample_clients(state, key)
+    xs, ys = batches_for(np.asarray(ids), round_seed=0)
+    if participation is None:
+        new_state, out = jax.jit(fa.run_round)(
+            state, ids, (xs, ys), jax.random.fold_in(key, 1)
+        )
+    else:
+        run = jax.jit(
+            lambda st, i, b, k, p: fa.run_round(st, i, b, k, participation=p)
+        )
+        new_state, out = run(
+            state, ids, (xs, ys), jax.random.fold_in(key, 1),
+            jnp.asarray(participation),
+        )
+    return state, new_state, out, np.asarray(ids)
+
+
+def test_fedavg_all_ones_participation_identical():
+    _, s_none, out_none, _ = _fed_round(None)
+    _, s_ones, out_ones, _ = _fed_round(np.ones(4, bool))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_none.params),
+        jax.tree_util.tree_leaves(s_ones.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(out_none["rel_volume"]) == float(out_ones["rel_volume"])
+
+
+def test_fedavg_dropped_client_excluded_and_residual_untouched():
+    part = np.array([False, True, True, True])
+    before, after, out, ids = _fed_round(part)
+    _, full, _, _ = _fed_round(None)
+    # excluding a client's update changes the server mean
+    assert any(
+        (np.asarray(a) != np.asarray(b)).any()
+        for a, b in zip(
+            jax.tree_util.tree_leaves(after.params),
+            jax.tree_util.tree_leaves(full.params),
+        )
+    )
+    for leaf in jax.tree_util.tree_leaves(after.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # the dropped client never compressed, so its C2S residual row is
+    # byte-identical to the pre-round state; live clients accrued EF mass
+    dropped, live = int(ids[0]), int(ids[1])
+    for b4, aft in zip(
+        jax.tree_util.tree_leaves(before.c2s_residuals),
+        jax.tree_util.tree_leaves(after.c2s_residuals),
+    ):
+        np.testing.assert_array_equal(np.asarray(b4)[dropped], np.asarray(aft)[dropped])
+    assert any(
+        np.abs(np.asarray(l)[live]).sum() > 0
+        for l in jax.tree_util.tree_leaves(after.c2s_residuals)
+    )
+
+
+# ---------------------------------------------------------------------- #
+# host-side retry
+# ---------------------------------------------------------------------- #
+
+
+def test_retry_backoff_sequence_and_success():
+    sleeps, calls = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry.retry_call(flaky, attempts=4, sleep=sleeps.append) == "ok"
+    assert sleeps == [0.05, 0.1]  # deterministic: base * multiplier^attempt
+
+
+def test_retry_exhaustion_reraises():
+    sleeps = []
+    with pytest.raises(OSError):
+        retry.retry_call(
+            lambda: (_ for _ in ()).throw(OSError("down")),
+            attempts=3, sleep=sleeps.append,
+        )
+    assert sleeps == [0.05, 0.1]  # attempts-1 sleeps, then the raise
+
+
+def test_retry_non_retryable_propagates_immediately():
+    sleeps = []
+
+    def corrupt():
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry.retry_call(corrupt, sleep=sleeps.append)
+    assert sleeps == []
+    with pytest.raises(ValueError):
+        retry.retry_call(lambda: 1, attempts=0)
+
+
+# ---------------------------------------------------------------------- #
+# analysis gate: negative fixtures for the new rules
+# ---------------------------------------------------------------------- #
+
+
+def test_ast_mask_host_branch_fires_on_value_branch():
+    src = "def f(mask):\n    if mask.sum() > 0:\n        return 1\n    return 0\n"
+    v = lint_source(src, "deepreduce_tpu/comm.py")
+    assert [x.rule for x in v] == [R_AST_MASK]
+    src_w = "def f(row_weights):\n    while row_weights.any():\n        pass\n"
+    assert [x.rule for x in lint_source(src_w, "deepreduce_tpu/train.py")] == [
+        R_AST_MASK
+    ]
+
+
+def test_ast_mask_host_branch_allows_presence_gates():
+    src = (
+        "def f(mask, cfg):\n"
+        "    if mask is not None and cfg.communicator in ('qar',):\n"
+        "        return 1\n"
+        "    if not (mask is None):\n"
+        "        return 2\n"
+        "    return 0\n"
+    )
+    assert lint_source(src, "deepreduce_tpu/comm.py") == []
+    # out of scope: host-side tooling may branch on anything
+    src_val = "def f(mask):\n    if mask.sum() > 0:\n        return 1\n"
+    assert lint_source(src_val, "deepreduce_tpu/tracking.py") == []
+
+
+def test_check_off_identical_detects_trace_residue():
+    class Seam:
+        scale = staticmethod(lambda x: x)
+
+    def make_fn():
+        # fresh function object per trace (check_off_identical's contract:
+        # jax caches traces by callable identity)
+        return lambda x: Seam.scale(x) + 1.0
+
+    args = (jnp.zeros((4,), jnp.float32),)
+    clean = check_off_identical(
+        "fixture", make_fn, args, [(Seam, "scale", lambda x: x)]
+    )
+    assert clean.violations == []
+    dirty = check_off_identical(
+        "fixture", make_fn, args, [(Seam, "scale", lambda x: x * 2.0)]
+    )
+    assert [v.rule for v in dirty.violations] == [R_RESILIENCE_OFF]
+    # the seam is restored after the check
+    assert Seam.scale(jnp.ones(())) == 1.0
+
+
+def test_quick_audit_includes_resilience_specs():
+    from deepreduce_tpu.analysis.jaxpr_audit import audit_specs
+
+    labels = [label for label, _ in audit_specs(quick=True)]
+    assert "resilience:off-identical" in labels
+    assert "exchange:fused-loop-resilient" in labels

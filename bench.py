@@ -42,7 +42,6 @@ import time
 
 import numpy as np
 
-BW_100MBPS = 12.5e6  # bytes/s
 PAPER_E2E_SPEEDUP = 7.8  # DRQSGD-BF-P0 vs baseline, paper Table 4
 LSTM_D = 4_053_428  # StackOverflow LSTM param count (BASELINE.md)
 RESNET50_D = 25_557_032
@@ -195,8 +194,15 @@ def measure_config(d, ratio, cfg_kwargs, iters):
     }
 
 
-def exchange_time(m, bw):
-    return m["payload_bytes"] / bw + m["t_encode_s"] + m["t_decode_s"]
+def _costmodel():
+    """deepreduce_tpu.costmodel — the extracted step-time model. BW_100MBPS,
+    `exchange_time` and the dense baseline row used to live inline here;
+    they now have one home shared with the rs_mode='auto' selector.
+    Imported lazily (the package __init__ pulls in jax, which bench defers
+    until the platform is pinned)."""
+    from deepreduce_tpu import costmodel
+
+    return costmodel
 
 
 def _latest_midround_record() -> str:
@@ -595,6 +601,156 @@ def decode_strategy_sweep(d: int = LSTM_D, workers: int = 8) -> dict:
     return out
 
 
+def rs_sweep(quick: bool = False, workers: int = 8) -> dict:
+    """The in-collective reduction sweep arm (`--rs-sweep`): every sparse_rs
+    rs_mode runs for real on the virtual CPU mesh to measure its per-step
+    compute, then gets priced at W in {8, 16} with the W-aware ring cost
+    model next to the fused drqsgd_bloom_* rows.
+
+    Compute measurement: one spmd step over the W-way mesh, amortized wall
+    time divided by W — the host timeshares the W shard programs on its
+    cores, so wall/W approximates ONE worker's compute (collectives on the
+    shared-memory mesh are memcpys, folded in as a small overestimate).
+    The fused rows come from `measure_config` (one encode + one decode,
+    single device) and are then modeled with `fused_step_time`, which
+    charges the W-fold receive volume and W decodes the gather-then-decode
+    design actually pays. W=16 reuses the W=8-measured compute terms: the
+    per-worker shards only shrink with W, so the reuse is conservative for
+    the in-collective routes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepreduce_tpu import sparse_rs
+    from deepreduce_tpu.utils import enable_compile_cache
+    from deepreduce_tpu.utils.compat import shard_map
+
+    enable_compile_cache()
+    cm = _costmodel()
+    d = LSTM_D if not quick else 500_000
+    ratio = 0.10  # the paper's Top-r 10% LSTM setting, same as the headline
+    W = workers
+    mesh = Mesh(np.array(jax.devices()[:W]), ("data",))
+    rng = np.random.default_rng(0)
+    g = jnp.asarray(
+        (rng.normal(size=(W, d)) * rng.random((W, d)) ** 2).astype(np.float32)
+    )
+    key = jax.random.PRNGKey(0)
+
+    rs_modes = ("sparse", "adaptive", "quantized", "sketch")
+    compute = {}
+    for mode in rs_modes:
+
+        def spmd(gw, mode=mode):
+            agg, own, _ = sparse_rs.exchange(
+                gw[0],
+                "data",
+                W,
+                ratio=ratio,
+                rs_mode=mode,
+                key=(key if mode in ("adaptive", "quantized") else None),
+            )
+            return agg[None]
+
+        fn = jax.jit(
+            shard_map(
+                spmd,
+                mesh=mesh,
+                in_specs=(P("data"),),
+                out_specs=P("data"),
+                check_vma=False,
+            )
+        )
+        _progress(f"rs-sweep: compiling rs_mode={mode} (d={d}, W={W})")
+        with _span(f"bench/rs-sweep/compile/{mode}"):
+            _sync(fn(g))
+        _progress(f"rs-sweep: timing rs_mode={mode}")
+        with _span(f"bench/rs-sweep/time/{mode}"):
+            wall = _timeit(fn, g, iters=2 if quick else 3, reps=3)
+        compute[mode] = wall / W
+        _progress(f"rs-sweep: {mode} wall={wall:.4f}s compute/worker={wall / W:.4f}s")
+
+    # the fused gather-then-decode competition: the three bloom flagship
+    # shapes from the headline table, measured flat then priced W-aware
+    bloom_cfgs = {
+        "drqsgd_bloom": dict(
+            deepreduce="both", index="bloom", value="qsgd", policy="p0",
+            fpr=0.02, memory="none",
+        ),
+        "drqsgd_bloom_sampled": dict(
+            compressor="topk_sampled", deepreduce="both", index="bloom",
+            value="qsgd", policy="p0", fpr=0.02, memory="none",
+        ),
+        "drqsgd_bloom_direct": dict(
+            compressor="topk_sampled", deepreduce="both", index="bloom",
+            value="qsgd", policy="p0", fpr=0.02, memory="none",
+            bloom_threshold_insert=True,
+        ),
+    }
+    with _span("bench/rs-sweep/bloom-rows"):
+        bloom_rows = {
+            name: measure_config(d, ratio, kw, 2 if quick else 3)
+            for name, kw in bloom_cfgs.items()
+        }
+
+    comparison = {}
+    for Wm in (8, 16):
+        fused = {n: cm.fused_step_time(m, Wm) for n, m in bloom_rows.items()}
+        incoll = {
+            mode: cm.rs_step_time(mode, d, Wm, ratio, t_compute_s=compute[mode])
+            for mode in rs_modes
+        }
+        best_f = min(fused, key=fused.get)
+        best_i = min(incoll, key=incoll.get)
+        comparison[f"W{Wm}"] = {
+            "fused_bloom_step_s": {n: round(v, 4) for n, v in fused.items()},
+            "in_collective_step_s": {n: round(v, 4) for n, v in incoll.items()},
+            # dense f32 ring allreduce, zero codec compute — the floor the
+            # whole compression story is measured against
+            "dense_allreduce_s": round(cm.allreduce_time(4.0 * d, Wm), 4),
+            "best_fused": best_f,
+            "best_in_collective": best_i,
+            "speedup_best_incoll_vs_best_fused": round(
+                fused[best_f] / incoll[best_i], 3
+            ),
+            "auto_selects": cm.select_rs_mode(d, Wm, ratio),
+            # per-collective injection bytes per route — the exact numbers
+            # the jx-wire-accounting 'collective' rule pins on the trace
+            "wire_bytes_per_collective": {
+                mode: cm.rs_wire_bytes(mode, d, Wm, ratio) for mode in rs_modes
+            },
+        }
+
+    return {
+        "metric": "in_collective_rs_vs_fused_bloom_step_time",
+        "unit": "s",
+        "platform": "cpu",
+        "detail": {
+            "model": "stackoverflow_lstm" if not quick else "quick",
+            "d": d,
+            "ratio": ratio,
+            "workers_measured": W,
+            "bw_bytes_per_s": cm.BW_100MBPS,
+            "cost_model": (
+                "W-aware ring model (costmodel.rs_step_time /"
+                " fused_step_time); compute measured on the CPU mesh"
+            ),
+            "rs_compute_s_per_worker": {
+                n: round(v, 4) for n, v in compute.items()
+            },
+            "bloom_measurements": {
+                n: {
+                    "payload_bytes": m["payload_bytes"],
+                    "t_encode_s": round(m["t_encode_s"], 4),
+                    "t_decode_s": round(m["t_decode_s"], 4),
+                }
+                for n, m in bloom_rows.items()
+            },
+            **comparison,
+        },
+    }
+
+
 def main() -> None:
     if _trace_out_path():
         from deepreduce_tpu.telemetry import spans
@@ -626,6 +782,14 @@ def main() -> None:
                 }
             )
         )
+        return
+    if "--rs-sweep" in sys.argv:
+        # standalone in-collective sweep mode: CPU-mesh only, one JSON
+        # record on stdout (committed as BENCH_INCOLL_*.json)
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu")
+        print(json.dumps(rs_sweep(quick="--quick" in sys.argv)))
         return
     if "--bucketed-sweep" in sys.argv:
         # standalone bucketed-exchange mode: CPU-mesh only, one JSON record
@@ -764,10 +928,13 @@ def main() -> None:
         measured = {
             name: measure_config(d, ratio, kw, iters) for name, kw in configs.items()
         }
-    dense = {"payload_bytes": 4.0 * d, "rel_volume": 1.0, "t_encode_s": 0.0, "t_decode_s": 0.0}
+    cm = _costmodel()
+    dense = cm.dense_measurement(d)
 
-    t_dense = exchange_time(dense, BW_100MBPS)
-    speedups = {n: t_dense / exchange_time(m, BW_100MBPS) for n, m in measured.items()}
+    t_dense = cm.exchange_time(dense, cm.BW_100MBPS)
+    speedups = {
+        n: t_dense / cm.exchange_time(m, cm.BW_100MBPS) for n, m in measured.items()
+    }
     best_name = max(speedups, key=speedups.get)
     best = speedups[best_name]
 
@@ -775,13 +942,13 @@ def main() -> None:
         "model": "stackoverflow_lstm" if not quick else "quick",
         "d": d,
         "ratio": ratio,
-        "bw_bytes_per_s": BW_100MBPS,
+        "bw_bytes_per_s": cm.BW_100MBPS,
         "t_dense_s": round(t_dense, 4),
         "dispatch_overhead_s": round(overhead, 4),
         "best_config": best_name,
         "speedup_vs_topr": round(
-            exchange_time(measured["topr"], BW_100MBPS)
-            / exchange_time(measured[best_name], BW_100MBPS),
+            cm.exchange_time(measured["topr"], cm.BW_100MBPS)
+            / cm.exchange_time(measured[best_name], cm.BW_100MBPS),
             3,
         ),
         "platform": jax.devices()[0].platform,

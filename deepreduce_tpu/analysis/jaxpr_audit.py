@@ -649,6 +649,59 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
             # 1 all_to_all (routed (val,idx) pairs) + 1 all_gather (reduced
             # shards back) — sparse_rs.py:123,143
             expect={"all_to_all": 1, "all_gather": 1},
+            # wire accounting over ALL collectives: the all_to_all rows plus
+            # the phase-2 gather must sum exactly to payload_bytes()
+            wire_mode="collective",
+        ),
+    )
+    # --- the r11 in-collective routes: same communicator, three new
+    # rs_mode arms. Each pins its full collective inventory AND exact
+    # per-collective operand bytes against costmodel.rs_payload_bytes ---
+    add(
+        "exchange:sparse_rs-adaptive",
+        lambda: audit_exchange(
+            "exchange:sparse_rs-adaptive",
+            C(communicator="sparse_rs", compressor="topk", memory="none",
+              deepreduce=None, compress_ratio=0.02, rs_mode="adaptive"),
+            # same skeleton as sparse (phase-1 all_to_all + phase-2
+            # all_gather); the density switch widens the gathered row to
+            # the fixed dual-interpretation lane budget, never adds a
+            # collective
+            expect={"all_to_all": 1, "all_gather": 1},
+            wire_mode="collective",
+        ),
+    )
+    add(
+        "exchange:sparse_rs-quantized",
+        lambda: audit_exchange(
+            "exchange:sparse_rs-quantized",
+            C(communicator="sparse_rs", compressor="topk", memory="none",
+              deepreduce=None, compress_ratio=0.02, rs_mode="quantized"),
+            # pmax (shared bucket norms) + the int8 psum_scatter — which
+            # lowers to one reduce_scatter eqn — + phase-2 all_gather of
+            # the re-selected top-K2
+            expect={"pmax": 1, "reduce_scatter": 1, "all_gather": 1},
+            wire_mode="collective",
+        ),
+    )
+    add(
+        "exchange:sparse_rs-sketch",
+        lambda: audit_exchange(
+            "exchange:sparse_rs-sketch",
+            C(communicator="sparse_rs", compressor="topk", memory="none",
+              deepreduce=None, compress_ratio=0.02, rs_mode="sketch"),
+            # ONE psum of the [rows, cols] count-sketch (linear, summable)
+            # + phase-2 all_gather of the unsketched shard's top-K2
+            expect={"psum": 1, "all_gather": 1},
+            wire_mode="collective",
+        ),
+    )
+    add(
+        "codec:countsketch",
+        lambda: audit_codec(
+            "codec:countsketch",
+            C(deepreduce="value", value="countsketch", compress_ratio=0.02,
+              min_compress_size=100),
         ),
     )
     return specs

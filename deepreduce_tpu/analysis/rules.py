@@ -118,7 +118,7 @@ class AuditContext:
     budget_scale: Optional[int] = None
     forbid_gather: bool = False
     expect_collectives: Optional[Dict[str, int]] = None
-    wire_mode: Optional[str] = None  # 'allgather' | 'ring'
+    wire_mode: Optional[str] = None  # 'allgather' | 'ring' | 'collective'
     expected_wire_bytes: Optional[int] = None
     num_workers: Optional[int] = None
     # exact static count of sparsifier-selection eqns (top_k/approx_top_k):
@@ -354,6 +354,30 @@ def rule_wire_accounting(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
                 R_WIRE_ACCOUNTING,
                 ctx.label,
                 f"all_gather operands move {moved} B/worker but "
+                f"payload_bytes() reports {ctx.expected_wire_bytes} B",
+            )
+        ]
+    if ctx.wire_mode == "collective":
+        # in-collective routes (sparse_rs): the wire story spans multiple
+        # collective shapes (all_to_all / psum_scatter / pmax / psum /
+        # all_gather), so sum the operand bytes of EVERY collective eqn and
+        # require exact agreement with payload_bytes() — which routes
+        # through costmodel.rs_payload_bytes, the same per-collective
+        # accounting the bench sweep prices
+        moved = sum(
+            _aval_bytes(v.aval)
+            for eqn in walk_eqns(jaxpr)
+            if eqn.primitive.name in COLLECTIVE_PRIMS
+            for v in eqn.invars
+            if getattr(v, "aval", None) is not None
+        )
+        if moved == ctx.expected_wire_bytes:
+            return []
+        return [
+            Violation(
+                R_WIRE_ACCOUNTING,
+                ctx.label,
+                f"collective operands move {moved} B/worker but "
                 f"payload_bytes() reports {ctx.expected_wire_bytes} B",
             )
         ]

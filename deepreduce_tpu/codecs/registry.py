@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from deepreduce_tpu.codecs import (
     bloom,
     bloom_native,
+    countsketch,
     doubleexp,
     gzip_codec,
     huffman,
@@ -361,6 +362,50 @@ class QSGDCodec(Codec):
         return _dc.replace(payload, indices=jnp.zeros((0,), jnp.int32)), None, 0
 
 
+class CountSketchCodec(Codec):
+    """Summable value codec (codecs/countsketch.py): the payload's sketch
+    planes are *linear*, so W workers' payloads can be summed element-wise
+    (one psum) and decoded once — the only value codec here whose aggregate
+    never needs per-worker decode. Lossy: decoded values carry collision
+    noise bounded by ||g||_2 / sqrt(cols) per row (median-of-rows tail);
+    the caller's residual error feedback re-injects the unsketch error."""
+
+    kind = "value"
+    order_preserving = True
+    fixed_size = True
+
+    def __init__(self, k, d, params=None):
+        super().__init__(k, d, params)
+        rows = int(self.params.get("rs_sketch_rows", 5))
+        cols = int(self.params.get("rs_sketch_cols", 0))
+        if cols <= 0:
+            cols = max(256, -(-2 * k // max(1, rows)))
+        self.meta = countsketch.CountSketchMeta(
+            k=k, rows=rows, cols=cols, seed=int(self.params.get("seed", 0))
+        )
+
+    def encode(self, sp, dense=None, *, step=0, key=None):
+        return countsketch.encode(sp, self.meta)
+
+    def decode(self, payload, shape, *, step=0):
+        return countsketch.decode(payload, self.meta, shape)
+
+    def index_wire_bits(self, payload):
+        return _raw_value_bits(self.k)
+
+    def value_wire_bits(self, payload):
+        return countsketch.wire_bits(payload, self.meta)
+
+    def both_mapping_max(self) -> int:
+        return 0
+
+    def strip_for_both(self, payload):
+        import dataclasses as _dc
+
+        # order-preserving: the mapping is the identity — elide it
+        return _dc.replace(payload, indices=jnp.zeros((0,), jnp.int32)), None, 0
+
+
 class GzipCodec(Codec):
     kind = "value"
     order_preserving = True
@@ -652,6 +697,7 @@ VALUE_CODECS: Dict[str, type] = {
     "doubleexp": DoubleExpCodec,
     "qsgd": QSGDCodec,
     "gzip": GzipCodec,
+    "countsketch": CountSketchCodec,
 }
 
 

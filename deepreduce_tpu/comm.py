@@ -272,6 +272,34 @@ class GradientExchanger:
                 "from the top-k + sharded re-selection), or the allgather "
                 "communicator for codec-compressed payloads."
             )
+        # resolve the sparse_rs route once, at construction: 'auto' asks the
+        # shared W-aware cost model (costmodel.select_rs_mode) to argmin the
+        # ring wire time of the concrete routes from (d, W, ratio) — the
+        # traced exchange only ever sees a concrete mode
+        self._rs_mode = cfg.rs_mode
+        if cfg.communicator == "sparse_rs" and cfg.rs_mode == "auto":
+            if num_workers is None:
+                raise ValueError(
+                    "rs_mode='auto' resolves against the W-aware cost model "
+                    "at construction and needs the static mesh size: "
+                    "construct GradientExchanger(..., num_workers=...)"
+                )
+            from deepreduce_tpu import costmodel
+
+            d = sum(
+                int(math.prod(l.shape)) if l.shape else 1
+                for l in jax.tree_util.tree_leaves(grads_like)
+            )
+            self._rs_mode = costmodel.select_rs_mode(
+                d,
+                num_workers,
+                cfg.compress_ratio,
+                headroom=cfg.rs_headroom,
+                out_headroom=cfg.rs_out_headroom,
+                block=cfg.rs_block_size,
+                rows=cfg.rs_sketch_rows,
+                cols=cfg.rs_sketch_cols,
+            )
         leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
         self.names = [_leaf_name(path) for path, _ in leaves]
         self._grad_dtypes = {
@@ -415,8 +443,11 @@ class GradientExchanger:
             raise ValueError(
                 f"participation masks renormalize the decode-side mean of the "
                 f"allgather/allreduce paths; communicator={cfg.communicator!r} "
-                "reduces inside the collective and would silently ignore the "
-                "mask — use communicator='allgather' or 'allreduce'"
+                "reduces inside the collective, where every worker OWNS a "
+                "universe shard via static all_to_all/psum_scatter routing — "
+                "a masked-out worker's shard would black-hole for everyone "
+                "(see DeepReduceConfig.__post_init__) — use "
+                "communicator='allgather' or 'allreduce'"
             )
         num_workers = jax.lax.psum(1, self.axis_name)
         if collect is not None:
@@ -438,7 +469,9 @@ class GradientExchanger:
         if cfg.communicator == "qar":
             return self._exchange_qar(grads, state, step=step, key=key)
         if cfg.communicator == "sparse_rs":
-            return self._exchange_sparse_rs(grads, state, step=step, key=key)
+            return self._exchange_sparse_rs(
+                grads, state, step=step, key=key, collect=collect
+            )
 
         if cfg.communicator == "allreduce" or cfg.deepreduce is None and cfg.compressor == "none":
             # dense baseline: NCCL allreduce -> psum (run_deepreduce.sh:51)
@@ -746,13 +779,21 @@ class GradientExchanger:
         )
 
     def _exchange_sparse_rs(
-        self, grads: Any, state: Any, *, step: jax.Array, key: Optional[jax.Array]
+        self,
+        grads: Any,
+        state: Any,
+        *,
+        step: jax.Array,
+        key: Optional[jax.Array],
+        collect: Optional[dict] = None,
     ) -> Tuple[Any, Any, WireStats]:
-        """Sparse reduce-scatter + allgather (sparse_rs.py — the Ok-Topk /
-        SparCML collective shape): top-k entries routed to shard owners via
-        all_to_all, reduced densely per shard, re-selected, allgathered.
-        Per-worker decode is O(k) instead of the allgather path's O(W·k).
-        Residual error feedback covers phase-1 (send-side) truncation."""
+        """Compressed in-collective allreduce (sparse_rs.py — the Ok-Topk /
+        SparCML collective shape, with the adaptive/quantized/sketch routes
+        of r11 behind `rs_mode`): entries routed/reduced inside the
+        collective, re-selected per shard, allgathered. Per-worker decode
+        is O(k) (or O(d·rows/W) for the sketch route) instead of the
+        allgather path's O(W·k). Residual error feedback covers send-side
+        truncation (and quantization/sketch noise in those routes)."""
         from deepreduce_tpu import sparse_rs
         from jax.flatten_util import ravel_pytree
 
@@ -762,6 +803,16 @@ class GradientExchanger:
                 "communicator='sparse_rs' needs the static mesh size: "
                 "construct GradientExchanger(..., num_workers=mesh.shape[axis])"
             )
+        rs_mode = self._rs_mode
+        if rs_mode in ("adaptive", "quantized"):
+            # stochastic-rounding routes need per-step randomness; the
+            # sparse/sketch routes never touch the key (the default-mode
+            # trace stays byte-identical to the pre-r11 program)
+            if key is None:
+                key = jax.random.PRNGKey(cfg.seed)
+            key = jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
+        else:
+            key = None
         compensated = grads
         if state is not None:
             compensated = memory.compensate(grads, state, beta=cfg.beta, gamma=cfg.gamma)
@@ -775,6 +826,14 @@ class GradientExchanger:
                 approx_topk=cfg.approx_topk,
                 headroom=cfg.rs_headroom,
                 out_headroom=cfg.rs_out_headroom,
+                rs_mode=rs_mode,
+                block_size=cfg.rs_block_size,
+                density_threshold=cfg.rs_density_threshold,
+                sketch_rows=cfg.rs_sketch_rows,
+                sketch_cols=cfg.rs_sketch_cols,
+                sketch_seed=cfg.seed,
+                key=key,
+                collect=collect,
             )
         agg = unravel(mean.astype(flat.dtype))
         new_state = state
@@ -856,7 +915,7 @@ class GradientExchanger:
                 qar.wire_bits_per_worker(d, self.num_workers, self.cfg.bucket_size) // 8
             )
         if self.cfg.communicator == "sparse_rs":
-            from deepreduce_tpu import sparse_rs
+            from deepreduce_tpu import costmodel
 
             d = sum(
                 int(math.prod(l.shape)) if l.shape else 1
@@ -864,12 +923,22 @@ class GradientExchanger:
             )
             if self.num_workers is None:
                 raise ValueError("sparse_rs payload accounting needs num_workers")
-            W = self.num_workers
-            b = sparse_rs.send_budget(d, self.cfg.compress_ratio, W, self.cfg.rs_headroom)
-            k2 = sparse_rs.out_budget(
-                d, self.cfg.compress_ratio, W, self.cfg.rs_out_headroom
+            # per-route injection bytes (sum over the route's collectives);
+            # the jx-wire-accounting 'collective' rule pins this against the
+            # traced collective operands, route by route
+            return int(
+                costmodel.rs_payload_bytes(
+                    self._rs_mode,
+                    d,
+                    self.num_workers,
+                    self.cfg.compress_ratio,
+                    headroom=self.cfg.rs_headroom,
+                    out_headroom=self.cfg.rs_out_headroom,
+                    block=self.cfg.rs_block_size,
+                    rows=self.cfg.rs_sketch_rows,
+                    cols=self.cfg.rs_sketch_cols,
+                )
             )
-            return (W * b + k2) * 8  # f32 value + i32 index per entry
         if self._bucketed is not None:
             # sum of the per-bucket PayloadLayout sizes — exactly what the C
             # bucketed all_gather operands carry (jx-wire-accounting checks

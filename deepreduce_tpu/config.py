@@ -78,6 +78,32 @@ class DeepReduceConfig:
     # output-volume convention (k entries total); raise to trade wire bytes
     # for coverage of shard-occupancy fluctuations
     rs_out_headroom: float = 1.0
+    # sparse_rs route (sparse_rs.py):
+    #   'sparse'    — the two-phase sparse reduce-scatter (pre-r11 trace,
+    #                 byte-identical when selected)
+    #   'adaptive'  — same phase 1; phase 2 switches per worker between
+    #                 (values, indices) and an int8 block-quantized dense
+    #                 shard on a traced density estimate (SparCML switch)
+    #   'quantized' — EQuARX arm: int8 psum_scatter against pmax-shared
+    #                 per-block norms, then the sparse phase 2
+    #   'sketch'    — S2-Reducer arm: count-sketched top-k summed by one
+    #                 psum, per-shard unsketch, then the sparse phase 2
+    #   'auto'      — costmodel.select_rs_mode picks from (d, W, ratio) at
+    #                 construction via the W-aware ring wire model
+    rs_mode: str = "sparse"  # sparse | adaptive | quantized | sketch | auto
+    # quantization block length (elements) for the adaptive dense rows and
+    # the quantized arm — one f32 norm per block on the wire. Distinct from
+    # `bucket_size` (QSGD codec / qar communicator bucket length).
+    rs_block_size: int = 256
+    # adaptive switch point: a worker's phase-2 row goes dense when its
+    # reduced shard's live fraction exceeds this. 1.0 = never (density is
+    # capped at 1.0), so the default adaptive trace equals the sparse route
+    # unless the threshold is lowered.
+    rs_density_threshold: float = 1.0
+    # count-sketch geometry for rs_mode='sketch': rows of the table, and
+    # its width (0 = auto-size to ~2k/rows buckets)
+    rs_sketch_rows: int = 5
+    rs_sketch_cols: int = 0
     use_pallas: bool = False  # pallas TPU kernels where applicable (QSGD PRNG)
     # fuse the whole pytree's payloads into ONE uint8 buffer per step and
     # run a single all_gather + one worker-decode loop, instead of one
@@ -177,11 +203,13 @@ class DeepReduceConfig:
     MEMORIES = ("residual", "none")
     COMMUNICATORS = ("allgather", "allreduce", "qar", "sparse_rs")
     DEEPREDUCE_MODES = (None, "value", "index", "both")
-    VALUE_CODECS = ("polyfit", "polyfit_host", "polyseg", "doubleexp", "qsgd", "gzip")
+    VALUE_CODECS = ("polyfit", "polyfit_host", "polyseg", "doubleexp", "qsgd", "gzip",
+                    "countsketch")
     INDEX_CODECS = ("bloom", "bloom_native", "integer_native", "rle", "integer",
                     "huffman")
     POLICIES = ("leftmost", "random", "p0", "conflict_sets", "conflict_sets_approx")
     BLOOM_BLOCKED = (False, True, "hash", "mod")
+    RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "auto")
 
     def __post_init__(self):
         def check(name, value, allowed):
@@ -201,6 +229,33 @@ class DeepReduceConfig:
         check("value", self.value, self.VALUE_CODECS)
         check("index", self.index, self.INDEX_CODECS)
         check("bloom_blocked", self.bloom_blocked, self.BLOOM_BLOCKED)
+        check("rs_mode", self.rs_mode, self.RS_MODES)
+        if self.rs_mode != "sparse" and self.communicator != "sparse_rs":
+            raise ValueError(
+                f"rs_mode={self.rs_mode!r} selects a sparse_rs route and "
+                "would be silently ignored with "
+                f"communicator={self.communicator!r} — use "
+                "communicator='sparse_rs' (or drop rs_mode)"
+            )
+        if self.rs_block_size < 4 or self.rs_block_size % 4:
+            raise ValueError(
+                "rs_block_size must be a positive multiple of 4 (int8 levels "
+                f"ride bitcast 4-per-f32-lane), got {self.rs_block_size}"
+            )
+        if not 0.0 <= self.rs_density_threshold <= 1.0:
+            raise ValueError(
+                "rs_density_threshold is a live fraction of the reduced "
+                f"shard and must be in [0, 1], got {self.rs_density_threshold}"
+            )
+        if self.rs_sketch_rows < 1:
+            raise ValueError(
+                f"rs_sketch_rows must be >= 1, got {self.rs_sketch_rows}"
+            )
+        if self.rs_sketch_cols < 0:
+            raise ValueError(
+                "rs_sketch_cols must be >= 1, or 0 to auto-size (~2k/rows), "
+                f"got {self.rs_sketch_cols}"
+            )
         if self.decode_strategy not in ("loop", "vmap", "ring"):
             raise ValueError(
                 f"decode_strategy must be 'loop', 'vmap' or 'ring', got "
@@ -246,11 +301,26 @@ class DeepReduceConfig:
                 "resilience=True (or drop the knob(s))"
             )
         if self.resilience and self.communicator not in ("allgather", "allreduce"):
+            # Why the mask cannot thread through qar/sparse_rs: in those
+            # exchanges every worker is also *infrastructure* — the static
+            # all_to_all/psum_scatter routing makes each worker the owner of
+            # one universe shard. A participation mask can zero a worker's
+            # CONTRIBUTION (expressible), but a dropped worker's OWNERSHIP
+            # cannot be masked: the collective permutation is baked into the
+            # trace, so its whole shard of the aggregate would black-hole
+            # for every surviving worker. Graceful degradation of an owner
+            # requires re-sharding the universe over the live set — a shape
+            # change, hence a retrace, which the per-step mask contract
+            # (one static trace, mask as traced data) rules out. allgather/
+            # allreduce have no owners: a dead worker only removes its own
+            # contribution, which renormalization absorbs.
             raise ValueError(
                 "resilience=True threads a participation mask through the "
                 "exchange, which only the allgather/allreduce communicators "
-                f"support — communicator={self.communicator!r} would silently "
-                "ignore the mask"
+                f"support — communicator={self.communicator!r} makes every "
+                "worker a shard owner (static all_to_all/psum_scatter "
+                "routing), so a dropped worker would black-hole its shard "
+                "of the aggregate instead of degrading gracefully"
             )
         chaos_on = (
             self.chaos_drop_rate > 0
@@ -309,6 +379,8 @@ class DeepReduceConfig:
             "sort": self.sort,
             "seed": self.seed,
             "use_pallas": self.use_pallas,
+            "rs_sketch_rows": self.rs_sketch_rows,
+            "rs_sketch_cols": self.rs_sketch_cols,
         }
 
 

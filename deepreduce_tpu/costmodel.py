@@ -1,0 +1,243 @@
+"""Shared wire/compute cost model for exchange-mode selection and bench.
+
+One implementation of the step-time model that bench.py previously
+duplicated inline (`T = payload_bytes/BW + t_enc + t_dec` at a 100 Mbps
+default link), plus the W-aware extensions the in-collective reduction
+sweep and the ``rs_mode="auto"`` selector need.
+
+Two families of estimators live here:
+
+- **Flat (W-independent)** — `exchange_time(m, bw)`: the historical bench
+  model. Payload bytes are the per-worker *injection* (what one worker
+  puts on the wire), encode/decode measured once. This is what every
+  committed BENCH_*.json before r11 reports; it stays byte-for-byte the
+  same function so those numbers remain reproducible.
+- **W-aware (ring)** — per-collective wire times from standard ring
+  algorithm costs, and `fused_step_time` / `rs_step_time` which model what
+  actually scales with W: the fused allgather path *receives* W-1 remote
+  payloads and runs W decodes per step, while an in-collective route pays
+  ~1x decode and ring-bounded wire. This is the model under which the
+  ROADMAP target "beat drqsgd_bloom_* at W>=8" is meaningful at all — in
+  the flat model W never appears.
+
+Everything here is host-side pure python/float math: it runs at
+construction time (mode selection) or in bench drivers, never under
+trace.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+# 100 Mbps in bytes/s — the paper's federated uplink assumption, and the
+# default link every committed bench record uses.
+BW_100MBPS = 12.5e6
+
+RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "auto")
+
+
+def dense_measurement(d: int) -> Dict[str, float]:
+    """The uncompressed f32 baseline row (zero codec compute)."""
+    return {
+        "payload_bytes": 4.0 * d,
+        "rel_volume": 1.0,
+        "t_encode_s": 0.0,
+        "t_decode_s": 0.0,
+    }
+
+
+def exchange_time(m: Dict[str, float], bw: float = BW_100MBPS) -> float:
+    """Flat per-worker step-time model: injection bytes over the link plus
+    one encode and one decode. Unchanged from the pre-r11 bench.py inline
+    form; every historical BENCH_*.json speedup is computed with this."""
+    return m["payload_bytes"] / bw + m["t_encode_s"] + m["t_decode_s"]
+
+
+# ---------------------------------------------------------------------------
+# W-aware ring collective wire times (per-worker seconds on one link).
+#
+# Standard ring costs for message of `size` bytes per worker:
+#   all_gather      — each worker receives (W-1) remote payloads
+#   all_to_all      — each worker sends/receives (W-1)/W of its buffer
+#   psum/allreduce  — reduce-scatter + allgather: 2*(W-1)/W of the buffer
+#   psum_scatter    — reduce-scatter half alone: (W-1)/W of the buffer
+# ---------------------------------------------------------------------------
+
+
+def allgather_time(payload_bytes: float, W: int, bw: float = BW_100MBPS) -> float:
+    return (W - 1) * payload_bytes / bw
+
+
+def all_to_all_time(buffer_bytes: float, W: int, bw: float = BW_100MBPS) -> float:
+    return (W - 1) / W * buffer_bytes / bw
+
+
+def allreduce_time(buffer_bytes: float, W: int, bw: float = BW_100MBPS) -> float:
+    return 2.0 * (W - 1) / W * buffer_bytes / bw
+
+
+def reduce_scatter_time(buffer_bytes: float, W: int, bw: float = BW_100MBPS) -> float:
+    return (W - 1) / W * buffer_bytes / bw
+
+
+def fused_step_time(
+    m: Dict[str, float], W: int, bw: float = BW_100MBPS
+) -> float:
+    """W-aware model of the fused gather-then-decode exchange: one encode,
+    an allgather of the per-worker payload, then W payload decodes (own +
+    W-1 remote). `m` is a flat measurement row (t_decode_s = one decode)."""
+    return (
+        m["t_encode_s"]
+        + allgather_time(m["payload_bytes"], W, bw)
+        + W * m["t_decode_s"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Per-rs_mode static wire accounting. These return the per-worker
+# *injection* bytes of every collective the route issues — the same
+# numbers GradientExchanger.payload_bytes() reports and the
+# jx-wire-accounting "collective" rule pins against the traced jaxpr.
+# ---------------------------------------------------------------------------
+
+
+def _shard_size(d: int, W: int) -> int:
+    return (d + W - 1) // W
+
+
+def _send_budget(d: int, ratio: float, W: int, headroom: float) -> int:
+    k = max(1, int(d * ratio))
+    return max(1, int(math.ceil(k / W * headroom)))
+
+
+def _out_budget(d: int, ratio: float, W: int, out_headroom: float) -> int:
+    k = max(1, int(d * ratio))
+    return min(max(1, int(math.ceil(k / W * out_headroom))), _shard_size(d, W))
+
+
+def sketch_cols(d: int, ratio: float, rows: int, cols: int = 0) -> int:
+    """Resolved sketch width: explicit `cols` wins; 0 auto-sizes to ~2k/rows
+    buckets (constant expected load factor ~1/2 per row) with a floor that
+    keeps tiny problems from degenerating."""
+    if cols > 0:
+        return int(cols)
+    k = max(1, int(d * ratio))
+    return max(256, int(math.ceil(2.0 * k / max(1, rows))))
+
+
+def quantized_padded_len(d: int, W: int, block: int) -> int:
+    """Length after padding d up to a multiple of W*block so every worker's
+    shard is whole blocks."""
+    chunk = W * block
+    return ((d + chunk - 1) // chunk) * chunk
+
+
+def adaptive_lane_count(d: int, ratio: float, W: int, out_headroom: float, block: int) -> int:
+    """f32 lanes in the adaptive phase-2 row (excluding the +1 flag lane):
+    max of the sparse encoding (2 lanes/entry) and the int8-block dense
+    shard encoding (levels bitcast into f32 lanes + one f32 norm/block)."""
+    S = _shard_size(d, W)
+    Sp = ((S + block - 1) // block) * block
+    dense_lanes = Sp // 4 + Sp // block
+    sparse_lanes = 2 * _out_budget(d, ratio, W, out_headroom)
+    return max(sparse_lanes, dense_lanes)
+
+
+def rs_wire_bytes(
+    mode: str,
+    d: int,
+    W: int,
+    ratio: float,
+    *,
+    headroom: float = 2.0,
+    out_headroom: float = 1.0,
+    block: int = 256,
+    rows: int = 5,
+    cols: int = 0,
+) -> Dict[str, float]:
+    """Per-collective injection bytes for one sparse_rs route. Keys are the
+    collective primitive names the route traces; values are the operand
+    bytes one worker contributes to that collective."""
+    B = _send_budget(d, ratio, W, headroom)
+    K2 = _out_budget(d, ratio, W, out_headroom)
+    if mode == "sparse":
+        return {"all_to_all": W * B * 8.0, "all_gather": K2 * 8.0}
+    if mode == "adaptive":
+        L = adaptive_lane_count(d, ratio, W, out_headroom, block)
+        return {"all_to_all": W * B * 8.0, "all_gather": (L + 1) * 4.0}
+    if mode == "quantized":
+        n = quantized_padded_len(d, W, block)
+        return {
+            "pmax": (n // block) * 4.0,
+            "psum_scatter": n * 1.0,
+            "all_gather": K2 * 8.0,
+        }
+    if mode == "sketch":
+        C = sketch_cols(d, ratio, rows, cols)
+        return {"psum": rows * C * 4.0, "all_gather": K2 * 8.0}
+    raise ValueError(f"unknown rs_mode {mode!r}")
+
+
+def rs_payload_bytes(mode: str, d: int, W: int, ratio: float, **kw) -> float:
+    """Total per-worker injection bytes for one route (sum over its
+    collectives) — the number payload_bytes()/jx-wire-accounting pin."""
+    return float(sum(rs_wire_bytes(mode, d, W, ratio, **kw).values()))
+
+
+_RING_TIME = {
+    "all_gather": allgather_time,
+    "all_to_all": all_to_all_time,
+    "psum": allreduce_time,
+    "pmax": allreduce_time,
+    "psum_scatter": reduce_scatter_time,
+}
+
+
+def rs_step_time(
+    mode: str,
+    d: int,
+    W: int,
+    ratio: float,
+    *,
+    t_compute_s: float = 0.0,
+    bw: float = BW_100MBPS,
+    **kw,
+) -> float:
+    """W-aware modeled step time of one in-collective route: ring wire time
+    of each collective it issues plus its (once-per-worker) compute."""
+    wire = 0.0
+    for prim, size in rs_wire_bytes(mode, d, W, ratio, **kw).items():
+        wire += _RING_TIME[prim](size, W, bw)
+    return wire + t_compute_s
+
+
+def select_rs_mode(
+    d: int,
+    W: int,
+    ratio: float,
+    *,
+    headroom: float = 2.0,
+    out_headroom: float = 1.0,
+    block: int = 256,
+    rows: int = 5,
+    cols: int = 0,
+    bw: float = BW_100MBPS,
+    modes: Optional[tuple] = None,
+) -> str:
+    """Resolve ``rs_mode="auto"`` at construction time: argmin of the
+    wire-only W-aware model over the concrete routes. At the 100 Mbps
+    default link the step is wire-dominated, so compute terms (which need
+    per-platform measurement) are deliberately excluded — the selector is
+    deterministic from (d, W, ratio) and static config alone."""
+    candidates = modes or ("sparse", "adaptive", "quantized", "sketch")
+    best, best_t = None, float("inf")
+    for mode in candidates:
+        t = rs_step_time(
+            mode, d, W, ratio,
+            headroom=headroom, out_headroom=out_headroom,
+            block=block, rows=rows, cols=cols, bw=bw,
+        )
+        if t < best_t:
+            best, best_t = mode, t
+    return best

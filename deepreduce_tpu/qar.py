@@ -33,18 +33,25 @@ import jax
 import jax.numpy as jnp
 
 
-def _bucket_quantize(
+def bucket_quantize(
     flat: jax.Array,
     quantum_num: int,
     bucket_size: int,
     key: jax.Array,
     use_pallas: bool = False,
+    norms: jax.Array = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """QSGD-style per-bucket stochastic quantization of a [n] vector (n a
     static multiple of bucket_size) -> (int8[n] levels, f32[n/bucket] norms).
     Shares the bucket geometry (codecs.qsgd.bucket_scale) and the
     floor+Bernoulli int8 step (ops.quantize_levels, incl. the Pallas
-    hardware-PRNG fast path) with the QSGD codec — one quantizer."""
+    hardware-PRNG fast path) with the QSGD codec — one quantizer.
+
+    `norms` optionally supplies externally-agreed per-bucket norms (e.g. a
+    `pmax` across workers) in place of the locally-measured L2 — required
+    when workers must share one scale so their int8 levels are summable
+    in-collective (sparse_rs rs_mode='quantized'). Supplied norms must
+    upper-bound the local per-element magnitudes or levels clip meaning."""
     from deepreduce_tpu.codecs.qsgd import bucket_scale
     from deepreduce_tpu.ops import quantize_levels
 
@@ -53,16 +60,27 @@ def _bucket_quantize(
             f"quantum_num={quantum_num} does not fit the int8 wire (max 127); "
             "levels would wrap and flip gradient signs"
         )
-    scale, norms = bucket_scale(flat, quantum_num, bucket_size)
+    if norms is None:
+        scale, norms = bucket_scale(flat, quantum_num, bucket_size)
+    else:
+        safe = jnp.where(norms > 0, norms, 1.0)
+        scale = jnp.broadcast_to(
+            (quantum_num / safe)[:, None], (norms.shape[0], bucket_size)
+        ).reshape(-1)
     levels = quantize_levels(flat, scale, key, use_pallas=use_pallas)
     return levels, norms
 
 
-def _bucket_dequantize(
+def bucket_dequantize(
     levels: jax.Array, norms: jax.Array, quantum_num: int, bucket_size: int
 ) -> jax.Array:
     b = levels.reshape(-1, bucket_size).astype(jnp.float32)
     return (b * (norms / quantum_num)[:, None]).reshape(-1)
+
+
+# internal aliases kept for call-site stability inside this module's history
+_bucket_quantize = bucket_quantize
+_bucket_dequantize = bucket_dequantize
 
 
 def pad_len(d: int, num_workers: int, bucket_size: int) -> int:

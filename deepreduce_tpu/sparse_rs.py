@@ -1,11 +1,13 @@
-"""Sparse reduce-scatter + allgather — the Ok-Topk / SparCML exchange shape.
+"""Compressed in-collective allreduce — the Ok-Topk / SparCML exchange shape.
 
 The allgather communicator (the reference's only compressed collective,
 README.md:37) makes every worker decode every peer's payload: O(W·k) decode
 work and W·k wire entries per worker. The sparse-allreduce literature
 (PAPERS.md: "Near-Optimal Sparse Allreduce" (Ok-Topk), SparCML, S2 Reducer)
-splits the universe into W contiguous shards instead:
+splits the universe into W contiguous shards instead, and this module now
+carries four routes over that skeleton, selected by ``rs_mode``:
 
+- ``sparse`` (default; byte-identical trace to the pre-r11 exchange):
     phase 1 (sparse reduce-scatter): each worker routes its top-k entries
         to the shard-owner via `all_to_all` (static per-shard budget,
         largest-|v| kept on overflow — the dropped mass stays in the
@@ -14,6 +16,26 @@ splits the universe into W contiguous shards instead:
     phase 2 (sparse allgather): the owner re-selects the top k/W of its
         *reduced* shard and `all_gather`s (values, global indices); every
         worker scatters W small payloads into the dense result.
+- ``adaptive`` (SparCML's stream-aware switch): same phase 1; after the
+    reduce, a traced live-entry count decides per worker whether its
+    phase-2 row travels as (values, indices) pairs or as an int8
+    block-quantized dense shard (per-block f32 scales, EQuARX style, via
+    qar.bucket_quantize). Both encodings ride one static
+    ``[max(sparse, dense) + 1]``-lane buffer whose last lane is the flag;
+    receivers compute both interpretations and `jnp.where`-select on the
+    flag (selection, not masking-by-multiply: the unused interpretation
+    bitcasts garbage lanes that may be NaN).
+- ``quantized`` (EQuARX reduce-scatter arm): no phase-1 sparsification —
+    the whole compensated gradient is int8 block-quantized against
+    `pmax`-shared per-block norms (shared scales + level budget
+    ``127 // W`` make the int8 `psum_scatter` an exact integer sum), each
+    worker dequantizes its summed shard and re-enters the sparse phase 2.
+- ``sketch`` (S2 Reducer): the top-k selection (sortless
+    `sparse.topk_sampled`) is count-sketched (codecs.countsketch); one
+    `psum` sums the linear sketches in-collective; each worker unsketches
+    only *its shard* (O(d·rows/W) — the decode itself is sharded) and
+    re-enters the sparse phase 2. Error feedback uses the unsketch
+    estimate of the worker's own sketch at the globally selected indices.
 
 Per-worker wire ~ k·headroom + k entries vs the allgather path's W·k, and
 decode is O(k) instead of O(W·k) — the gap grows with the mesh. The phase-2
@@ -22,7 +44,10 @@ bounded by the per-shard budget) while phase-1 truncation is error-fed back
 like any sparsifier.
 
 All static-shape: budgets derive from (d, ratio, W) at trace time; live
-counts ride in-band. Runs inside shard_map over the data axis.
+counts ride in-band; the adaptive switch is data on the wire, not a trace
+decision. Runs inside shard_map over the data axis. Mode selection
+(including ``auto`` via deepreduce_tpu.costmodel.select_rs_mode) happens at
+GradientExchanger construction — `exchange` receives a concrete mode.
 """
 
 from __future__ import annotations
@@ -33,9 +58,12 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from deepreduce_tpu import sparse
+from deepreduce_tpu import qar, sparse
+from deepreduce_tpu.codecs import countsketch
 from deepreduce_tpu.metrics import WireStats
 from deepreduce_tpu.telemetry import spans
+
+RS_EXCHANGE_MODES = ("sparse", "adaptive", "quantized", "sketch")
 
 
 def shard_size(d: int, num_workers: int) -> int:
@@ -62,6 +90,33 @@ def out_budget(
     return min(b, shard_size(d, num_workers))
 
 
+def padded_shard(d: int, num_workers: int, block: int) -> int:
+    """Shard length rounded up to whole quantization blocks (adaptive
+    phase-2 dense rows and the quantized arm both need block-aligned
+    shards)."""
+    s = shard_size(d, num_workers)
+    return ((s + block - 1) // block) * block
+
+
+def adaptive_lanes(
+    d: int, ratio: float, num_workers: int, out_headroom: float, block: int
+) -> int:
+    """f32 lanes in the adaptive phase-2 row, excluding the +1 flag lane:
+    max of the sparse encoding (2 lanes per phase-2 slot) and the dense
+    encoding (int8 levels bitcast 4-per-lane + one f32 norm per block)."""
+    sp = padded_shard(d, num_workers, block)
+    dense_lanes = sp // 4 + sp // block
+    sparse_lanes = 2 * out_budget(d, ratio, num_workers, out_headroom)
+    return max(sparse_lanes, dense_lanes)
+
+
+def quantized_levels_budget(num_workers: int) -> int:
+    """Max |level| each worker may emit so the W-worker int8 sum cannot
+    exceed 127: with pmax-shared norms every worker's stochastic level is
+    bounded by this, and W * (127 // W) <= 127."""
+    return max(1, 127 // num_workers)
+
+
 def exchange(
     flat: jax.Array,
     axis_name: str,
@@ -71,9 +126,153 @@ def exchange(
     approx_topk: bool = False,
     headroom: float = 2.0,
     out_headroom: float = 1.0,
+    rs_mode: str = "sparse",
+    block_size: int = 256,
+    density_threshold: float = 1.0,
+    sketch_rows: int = 5,
+    sketch_cols: int = 0,
+    sketch_seed: int = 0,
+    key: Optional[jax.Array] = None,
+    collect: Optional[dict] = None,
 ) -> Tuple[jax.Array, jax.Array, WireStats]:
     """-> (mean gradient f32[d], own-transmitted dense f32[d] for error
-    feedback, wire stats). Call inside shard_map over `axis_name`."""
+    feedback, wire stats). Call inside shard_map over `axis_name`.
+
+    `rs_mode` must be one of RS_EXCHANGE_MODES (``auto`` is resolved by the
+    caller). `key` is required by the stochastic-rounding routes (adaptive,
+    quantized). `collect`, when a dict, receives the adaptive route's
+    density/switch observables."""
+    if rs_mode == "sparse":
+        return _exchange_sparse(
+            flat, axis_name, num_workers, ratio=ratio, approx_topk=approx_topk,
+            headroom=headroom, out_headroom=out_headroom,
+        )
+    if rs_mode == "adaptive":
+        return _exchange_adaptive(
+            flat, axis_name, num_workers, ratio=ratio, approx_topk=approx_topk,
+            headroom=headroom, out_headroom=out_headroom, block=block_size,
+            density_threshold=density_threshold, key=key, collect=collect,
+        )
+    if rs_mode == "quantized":
+        return _exchange_quantized(
+            flat, axis_name, num_workers, ratio=ratio,
+            out_headroom=out_headroom, block=block_size, key=key,
+        )
+    if rs_mode == "sketch":
+        return _exchange_sketch(
+            flat, axis_name, num_workers, ratio=ratio,
+            out_headroom=out_headroom, rows=sketch_rows, cols=sketch_cols,
+            seed=sketch_seed,
+        )
+    raise ValueError(
+        f"rs_mode={rs_mode!r} is not a concrete sparse_rs route "
+        f"(expected one of {RS_EXCHANGE_MODES}; 'auto' must be resolved by "
+        "the caller via costmodel.select_rs_mode)"
+    )
+
+
+def _phase1_route(flat, axis_name, W, S, B, *, ratio, approx_topk):
+    """Shared phase 1: top-k select, route entries to their shard-owners
+    through one all_to_all, scatter-add into the owner's dense shard.
+    Returns (shard_buf f32[S], keep mask, routed idxs/vals, pos) — the
+    latter three feed the own-transmitted EF scatter."""
+    # sort_indices=False keeps lax.top_k's descending-|v| order — the
+    # overflow-drop-smallest property below depends on it
+    with spans.span("sparse_rs/select"):
+        sp = sparse.topk(flat, ratio, sort_indices=False, approx=approx_topk)
+    k = sp.k
+
+    live = jnp.arange(k, dtype=jnp.int32) < sp.nnz
+    shard_of = jnp.where(live, sp.indices // S, W)  # dead -> parked shard W
+    # stable sort by shard keeps lax.top_k's descending-|v| order within
+    # each shard, so budget overflow drops the smallest magnitudes
+    order = jnp.argsort(shard_of, stable=True)
+    sh = shard_of[order]
+    vals = sp.values[order]
+    idxs = sp.indices[order]
+    # per-shard rank = position within my shard's run
+    pos = jnp.arange(k, dtype=jnp.int32)
+    first_of_run = jnp.where(
+        jnp.concatenate([jnp.ones((1,), bool), sh[1:] != sh[:-1]]), pos, -1
+    )
+    run_start = jax.lax.cummax(first_of_run)
+    rank = pos - run_start
+    keep = jnp.logical_and(sh < W, rank < B)
+    # scatter into the [W, B] send matrix (unique targets by construction)
+    tgt = jnp.where(keep, sh * B + rank, W * B + pos)
+    send_v = (
+        jnp.zeros((W * B,), flat.dtype)
+        .at[tgt].set(vals, mode="drop", unique_indices=True)
+        .reshape(W, B)
+    )
+    # local index within the shard; dead slots point at 0 with value 0
+    send_i = (
+        jnp.zeros((W * B,), jnp.int32)
+        .at[tgt].set(idxs - sh * S, mode="drop", unique_indices=True)
+        .reshape(W, B)
+    )
+    # ONE collective per phase: ride the indices next to the values as
+    # bitcast f32 lanes in the same buffer (the fused-allgather pattern)
+    send_buf = jnp.concatenate(
+        [send_v.astype(jnp.float32),
+         jax.lax.bitcast_convert_type(send_i, jnp.float32)], axis=1
+    )  # [W, 2B]
+    with spans.span("sparse_rs/route"):
+        rx = jax.lax.all_to_all(
+            send_buf, axis_name, split_axis=0, concat_axis=0, tiled=True
+        )
+    rx_v = rx[:, :B]
+    rx_i = jax.lax.bitcast_convert_type(rx[:, B:], jnp.int32)
+
+    with spans.span("sparse_rs/reduce"):
+        shard_buf = jnp.zeros((S,), jnp.float32).at[rx_i.reshape(-1)].add(
+            rx_v.reshape(-1).astype(jnp.float32)
+        )
+    # zero-value dead slots all land on local index 0: adding 0 is exact
+    return shard_buf, keep, idxs, vals, pos
+
+
+def _own_transmitted(flat, keep, idxs, vals, pos, W, S, d):
+    """Own-transmitted mass (what actually left this worker, phase-1
+    truncation applied) for residual error feedback; dead/overflow slots
+    park at unique out-of-range targets."""
+    return (
+        jnp.zeros((W * S,), flat.dtype)
+        .at[jnp.where(keep, idxs, W * S + pos)]
+        .set(vals, mode="drop", unique_indices=True)[:d]
+    )
+
+
+def _phase2_pack(shard_est, widx, S, K2):
+    """Re-select the reduced/estimated shard: -> [2*K2] f32 buffer with
+    bitcast global indices in the upper lanes."""
+    mag = jnp.abs(shard_est)
+    top_v, top_i = jax.lax.top_k(mag, K2)
+    out_vals = shard_est[top_i]
+    out_idx = (top_i + widx * S).astype(jnp.int32)
+    return jnp.concatenate(
+        [out_vals.astype(jnp.float32),
+         jax.lax.bitcast_convert_type(out_idx, jnp.float32)]
+    )
+
+
+def _phase2_unpack(gathered, K2, W, S):
+    """-> (values f32[W*K2], clipped global indices i32[W*K2], dense mean
+    numerator f32[W*S])."""
+    gathered_v = gathered[:, :K2]
+    gathered_i = jax.lax.bitcast_convert_type(gathered[:, K2:], jnp.int32)
+    gi = jnp.clip(gathered_i.reshape(-1), 0, W * S - 1)
+    dense = jnp.zeros((W * S,), jnp.float32).at[gi].add(
+        gathered_v.reshape(-1)
+    )
+    return gathered_v.reshape(-1), gi, dense
+
+
+def _exchange_sparse(
+    flat, axis_name, num_workers, *, ratio, approx_topk, headroom, out_headroom
+):
+    """The pre-r11 route, body unchanged — the all-modes-off trace must stay
+    byte-identical to the r10 baseline."""
     d = flat.shape[0]
     W = num_workers
     S = shard_size(d, W)
@@ -176,3 +375,203 @@ def exchange(
         dense_bits=jnp.asarray(d * 32.0, jnp.float32),
     )
     return mean.astype(flat.dtype), own_dense, stats
+
+
+def _exchange_adaptive(
+    flat, axis_name, num_workers, *, ratio, approx_topk, headroom,
+    out_headroom, block, density_threshold, key, collect,
+):
+    """Sparse phase 1, density-switched phase 2: each worker's gathered row
+    is either (values, bitcast indices) or an int8 block-quantized dense
+    shard, flagged in-band. The switch is traced data — one static trace
+    covers both branches on both sides of the collective."""
+    if key is None:
+        raise ValueError("rs_mode='adaptive' needs a PRNG key (stochastic "
+                         "rounding of the dense phase-2 rows)")
+    d = flat.shape[0]
+    W = num_workers
+    S = shard_size(d, W)
+    Sp = padded_shard(d, W, block)
+    B = send_budget(d, ratio, W, headroom)
+    K2 = out_budget(d, ratio, W, out_headroom)
+    L = adaptive_lanes(d, ratio, W, out_headroom, block)
+    q = 127  # per-row dequantize is per-worker — no summation, full int8 range
+
+    shard_buf, keep, idxs, vals, pos = _phase1_route(
+        flat, axis_name, W, S, B, ratio=ratio, approx_topk=approx_topk
+    )
+    widx = jax.lax.axis_index(axis_name)
+
+    # --- traced density decision ---------------------------------------- #
+    live_count = jnp.sum((shard_buf != 0.0).astype(jnp.float32))
+    density = live_count / float(S)
+    go_dense = (density > density_threshold).astype(jnp.float32)
+    if collect is not None:
+        collect["rs_density"] = density
+        collect["rs_dense_switches"] = go_dense
+
+    # --- both phase-2 encodings over one static buffer ------------------- #
+    sparse_row = jnp.zeros((L,), jnp.float32).at[: 2 * K2].set(
+        _phase2_pack(shard_buf, widx, S, K2)
+    )
+    with spans.span("sparse_rs/adaptive-quantize"):
+        levels, norms = qar.bucket_quantize(
+            jnp.zeros((Sp,), jnp.float32).at[:S].set(shard_buf),
+            q, block, jax.random.fold_in(key, widx),
+        )
+    lv_lanes = jax.lax.bitcast_convert_type(levels.reshape(Sp // 4, 4), jnp.float32)
+    dense_row = jnp.zeros((L,), jnp.float32).at[: Sp // 4 + Sp // block].set(
+        jnp.concatenate([lv_lanes, norms])
+    )
+    row = jnp.concatenate(
+        [jnp.where(go_dense > 0.5, dense_row, sparse_row), go_dense[None]]
+    )  # [L+1]
+    with spans.span("sparse_rs/allgather"):
+        gathered = jax.lax.all_gather(row, axis_name)  # [W, L+1]
+
+    # --- decode both interpretations, select on the flag ----------------- #
+    flags = gathered[:, L]  # [W]
+    body = gathered[:, :L]
+    # sparse interpretation (garbage lanes under a dense flag may be NaN —
+    # jnp.where *selects*, so they never reach the accumulator)
+    s_vals = body[:, :K2]
+    s_idx = jax.lax.bitcast_convert_type(body[:, K2 : 2 * K2], jnp.int32)
+    s_contrib = jnp.zeros((W * S,), jnp.float32).at[
+        jnp.clip(s_idx.reshape(-1), 0, W * S - 1)
+    ].add(
+        jnp.where(flags[:, None] < 0.5, s_vals, 0.0).reshape(-1)
+    )
+    # dense interpretation: per-row int8 dequantize, rows masked by flag
+    lv_rx = jax.lax.bitcast_convert_type(
+        body[:, : Sp // 4], jnp.int8
+    ).reshape(W, Sp)
+    nm_rx = body[:, Sp // 4 : Sp // 4 + Sp // block]
+    deq = jax.vmap(lambda l, nm: qar.bucket_dequantize(l, nm, q, block))(
+        lv_rx, nm_rx
+    )  # [W, Sp]
+    d_contrib = jnp.where(
+        flags[:, None] > 0.5, jnp.nan_to_num(deq[:, :S]), 0.0
+    ).reshape(W * S)
+    mean = (s_contrib + d_contrib)[:d] / W
+
+    own_dense = _own_transmitted(flat, keep, idxs, vals, pos, W, S, d)
+    stats = WireStats(
+        index_bits=jnp.asarray(W * B * 32.0, jnp.float32),
+        value_bits=jnp.asarray((W * B + L + 1) * 32.0, jnp.float32),
+        dense_bits=jnp.asarray(d * 32.0, jnp.float32),
+    )
+    return mean.astype(flat.dtype), own_dense, stats
+
+
+def _exchange_quantized(
+    flat, axis_name, num_workers, *, ratio, out_headroom, block, key
+):
+    """EQuARX-style phase 1: int8 block quantization against pmax-shared
+    norms, exact integer in-collective sum via psum_scatter, then the
+    sparse phase-2 re-select over the dequantized summed shard. No
+    phase-1 sparsifier — stochastic rounding is unbiased and its realized
+    noise lands in the residual via the own-contribution estimate."""
+    if key is None:
+        raise ValueError("rs_mode='quantized' needs a PRNG key (stochastic "
+                         "rounding of the int8 levels)")
+    d = flat.shape[0]
+    W = num_workers
+    n = padded_shard(d, W, block) * W
+    Ssh = n // W
+    K2 = out_budget(d, ratio, W, out_headroom)
+    q = quantized_levels_budget(W)
+    widx = jax.lax.axis_index(axis_name)
+
+    gp = jnp.zeros((n,), jnp.float32).at[:d].set(flat)
+    # pmax-shared norms: every worker's per-element magnitude is bounded by
+    # its local block L2 norm, hence by the shared max — so each stochastic
+    # level is <= q and the W-worker int8 sum cannot exceed W*q <= 127
+    norms_local = jnp.linalg.norm(gp.reshape(-1, block), axis=1)
+    with spans.span("sparse_rs/norm-pmax"):
+        norms_shared = jax.lax.pmax(norms_local, axis_name)
+    with spans.span("sparse_rs/quantize"):
+        levels, _ = qar.bucket_quantize(
+            gp, q, block, jax.random.fold_in(key, widx), norms=norms_shared
+        )
+    with spans.span("sparse_rs/reduce-scatter"):
+        summed = jax.lax.psum_scatter(
+            levels, axis_name, scatter_dimension=0, tiled=True
+        )  # int8[Ssh] — exact: levels bounded so the sum never wraps
+    my_norms = jax.lax.dynamic_slice(
+        norms_shared, (widx * (Ssh // block),), (Ssh // block,)
+    )
+    shard_est = qar.bucket_dequantize(summed, my_norms, q, block)
+
+    # --- phase 2: sparse re-select + allgather --------------------------- #
+    out_buf = _phase2_pack(shard_est, widx, Ssh, K2)
+    with spans.span("sparse_rs/allgather"):
+        gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
+    _, gi, dense = _phase2_unpack(gathered, K2, W, Ssh)
+    mean = dense[:d] / W
+
+    # own contribution = my dequantized levels at the globally selected
+    # indices (disjoint shards -> unique indices, add == set)
+    my_deq = qar.bucket_dequantize(levels, norms_shared, q, block)
+    own_dense = jnp.zeros((W * Ssh,), jnp.float32).at[gi].add(my_deq[gi])[:d]
+
+    stats = WireStats(
+        index_bits=jnp.asarray(K2 * 32.0, jnp.float32),
+        value_bits=jnp.asarray(
+            n * 8.0 + (n // block) * 32.0 + K2 * 32.0, jnp.float32
+        ),
+        dense_bits=jnp.asarray(d * 32.0, jnp.float32),
+    )
+    return mean.astype(flat.dtype), own_dense.astype(flat.dtype), stats
+
+
+def _exchange_sketch(
+    flat, axis_name, num_workers, *, ratio, out_headroom, rows, cols, seed
+):
+    """S2-Reducer phase 1: count-sketch the (sortless sampled) top-k
+    selection, sum the linear sketches with one psum, unsketch only this
+    worker's shard, then the sparse phase-2 re-select + allgather. Decode
+    work is O(d·rows/W) per worker — sharded, unlike the fused path's
+    O(W·k)."""
+    d = flat.shape[0]
+    W = num_workers
+    S = shard_size(d, W)
+    K2 = out_budget(d, ratio, W, out_headroom)
+    k = sparse.num_slots(d, ratio)
+    C = cols if cols > 0 else max(256, int(math.ceil(2.0 * k / max(1, rows))))
+    widx = jax.lax.axis_index(axis_name)
+
+    with spans.span("sparse_rs/select"):
+        sp = sparse.topk_sampled(flat, ratio, k=k)
+    live = jnp.arange(sp.k, dtype=jnp.int32) < sp.nnz
+    sel_vals = jnp.where(live, sp.values, 0.0)
+    with spans.span("sparse_rs/sketch"):
+        sk = countsketch.sketch_from_sparse(
+            sel_vals, sp.indices, rows, C, seed=seed
+        )
+    with spans.span("sparse_rs/psum"):
+        summed = jax.lax.psum(sk, axis_name)  # linear: sketch of the sum
+
+    # --- unsketch my shard only ------------------------------------------ #
+    with spans.span("sparse_rs/unsketch"):
+        shard_idx = jnp.arange(S, dtype=jnp.int32) + widx * S
+        shard_est = countsketch.unsketch_at(summed, shard_idx, seed=seed)
+
+    # --- phase 2: sparse re-select + allgather --------------------------- #
+    out_buf = _phase2_pack(shard_est, widx, S, K2)
+    with spans.span("sparse_rs/allgather"):
+        gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
+    _, gi, dense = _phase2_unpack(gathered, K2, W, S)
+    mean = dense[:d] / W
+
+    # error feedback via the unsketch estimate of *my own* sketch at the
+    # globally selected coordinates — what this worker effectively
+    # contributed to the decoded mean, collision noise included
+    own_est = countsketch.unsketch_at(sk, gi, seed=seed)
+    own_dense = jnp.zeros((W * S,), jnp.float32).at[gi].add(own_est)[:d]
+
+    stats = WireStats(
+        index_bits=jnp.asarray(K2 * 32.0, jnp.float32),
+        value_bits=jnp.asarray((rows * C + K2) * 32.0, jnp.float32),
+        dense_bits=jnp.asarray(d * 32.0, jnp.float32),
+    )
+    return mean.astype(flat.dtype), own_dense.astype(flat.dtype), stats

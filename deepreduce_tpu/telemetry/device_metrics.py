@@ -48,6 +48,12 @@ class MetricAccumulators:
     live_workers: jax.Array   # Σ per-step live-worker count (participation)
     dropped_steps: jax.Array  # steps where ≥1 worker was masked out
     checksum_failures: jax.Array  # Σ failed-checksum payload decodes
+    # in-collective reduction (sparse_rs rs_mode='adaptive'): Σ per-step
+    # traced post-reduce shard density, and Σ steps the density switch
+    # chose the dense int8 phase-2 row over the sparse (value, index) one —
+    # divide both by `steps` on the host for the running rates
+    rs_density: jax.Array
+    rs_dense_switches: jax.Array
     # Σ per-BUCKET saturation counts, f32[C] in bucket-spec order for the
     # bucketed exchange (f32[0] when unbucketed) — keeps one chronically
     # overfull bucket visible next to the summed `saturated` total
@@ -76,6 +82,8 @@ class MetricAccumulators:
         live_workers=0.0,
         dropped_steps=0.0,
         checksum_failures=0.0,
+        rs_density=0.0,
+        rs_dense_switches=0.0,
         bucket_saturated=0.0,
     ) -> "MetricAccumulators":
         f = lambda x: jnp.asarray(x, jnp.float32)
@@ -93,6 +101,8 @@ class MetricAccumulators:
             live_workers=self.live_workers + f(live_workers),
             dropped_steps=self.dropped_steps + f(dropped_steps),
             checksum_failures=self.checksum_failures + f(checksum_failures),
+            rs_density=self.rs_density + f(rs_density),
+            rs_dense_switches=self.rs_dense_switches + f(rs_dense_switches),
             # broadcasts: [C] + [C] per-step vector, or [C] + 0.0 when the
             # caller has nothing to report this step (and [0] + 0.0 when
             # unbucketed — a no-op on the empty vector)
@@ -143,4 +153,8 @@ class MetricAccumulators:
             "live_workers_per_step": vals["live_workers"] / steps,
             "dropped_steps": vals["dropped_steps"],
             "checksum_failures": vals["checksum_failures"],
+            # adaptive sparse_rs: mean traced shard density after the
+            # phase-1 reduce, and the dense-row switch rate
+            "rs_density_per_step": vals["rs_density"] / steps,
+            "rs_dense_switch_rate": vals["rs_dense_switches"] / steps,
         }

@@ -190,6 +190,15 @@ def make_worker_step(
             live_workers=live,
             dropped_steps=dropped,
             checksum_failures=collect.get("checksum_failures", 0.0),
+            # adaptive sparse_rs: per-worker shard density and dense-switch
+            # flag, pmean'd so the accumulator stores the mean shard
+            # density and the fraction of phase-2 rows sent dense
+            rs_density=jax.lax.pmean(collect["rs_density"], axis)
+            if "rs_density" in collect
+            else 0.0,
+            rs_dense_switches=jax.lax.pmean(collect["rs_dense_switches"], axis)
+            if "rs_dense_switches" in collect
+            else 0.0,
             bucket_saturated=(
                 jax.lax.psum(bucket_sat, axis) if bucket_sat is not None else 0.0
             ),

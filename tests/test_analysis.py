@@ -235,6 +235,33 @@ def test_wire_accounting_mismatch_caught():
     _only(run_rules(closed, bad), rules.R_WIRE_ACCOUNTING)
 
 
+def test_wire_accounting_collective_mode():
+    """The r11 'collective' wire mode sums operand bytes over EVERY
+    collective eqn (a psum'd sketch + a gathered payload here), so routes
+    whose wire story spans multiple collective shapes get exact
+    accounting; one byte of drift is a violation."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = audit_mesh()
+    rows_cols, d = (5, 64), 128
+
+    def spmd(x):
+        sk = jax.lax.psum(x[0, : rows_cols[0] * rows_cols[1]], AXIS)
+        out = jax.lax.all_gather(x[0, : d // 2], AXIS)
+        return (sk.sum() + out.sum())[None]
+
+    fn = shard_map(spmd, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, d * 4), jnp.float32))
+    want = 4 * rows_cols[0] * rows_cols[1] + 4 * (d // 2)
+    good = AuditContext(label="fixture:coll-ok", wire_mode="collective",
+                        expected_wire_bytes=want)
+    assert run_rules(closed, good) == []
+    bad = AuditContext(label="fixture:coll-bad", wire_mode="collective",
+                       expected_wire_bytes=want + 1)
+    _only(run_rules(closed, bad), rules.R_WIRE_ACCOUNTING)
+
+
 def test_codec_invocation_count_caught():
     """A 'bucketed' exchange that runs a per-leaf top-k breaks the
     O(buckets) codec contract — the count of selection eqns is the proxy."""

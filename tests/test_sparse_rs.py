@@ -197,3 +197,318 @@ def test_phase1_overflow_drops_smallest_magnitude():
     assert len(sent) == B  # exactly the budget went out
     # the B sent entries are the B largest magnitudes (highest positions)
     np.testing.assert_array_equal(np.sort(sent), np.arange(k - B, k))
+
+
+# --------------------------------------------------------------------- #
+# r11: edge-case geometry (W=2, unaligned d, capped out budget)
+# --------------------------------------------------------------------- #
+
+
+def _run_mode(flat_w, ratio, mode, *, workers=W, headroom=2.0,
+              out_headroom=1.0, density_threshold=1.0, with_collect=False,
+              **kw):
+    """Generic runner for any rs_mode on a `workers`-wide mesh."""
+    key = jax.random.PRNGKey(0)
+
+    def spmd(g):
+        collect = {} if with_collect else None
+        mean, own, stats = sparse_rs.exchange(
+            g[0], "data", workers, ratio=ratio, rs_mode=mode,
+            headroom=headroom, out_headroom=out_headroom,
+            density_threshold=density_threshold,
+            key=(key if mode in ("adaptive", "quantized") else None),
+            collect=collect, **kw,
+        )
+        if with_collect:
+            return (mean[None], own[None],
+                    collect["rs_density"][None], collect["rs_dense_switches"][None])
+        return mean[None], own[None]
+
+    out_specs = (
+        (P("data"), P("data"), P("data"), P("data")) if with_collect
+        else (P("data"), P("data"))
+    )
+    fn = jax.jit(
+        shard_map(
+            spmd, mesh=shared_mesh(workers), in_specs=(P("data"),),
+            out_specs=out_specs, check_vma=False,
+        )
+    )
+    return fn(flat_w)
+
+
+def test_w2_mesh_exact_with_ample_budgets():
+    """The smallest real mesh (W=2): ample budgets must still be lossless
+    against the mean-of-topk oracle — shard routing with exactly one peer."""
+    rng = np.random.default_rng(10)
+    W2, d, ratio = 2, 4096, 0.02
+    flat_w = rng.normal(size=(W2, d)).astype(np.float32)
+    mean, _ = _run_mode(
+        jnp.asarray(flat_w), ratio, "sparse", workers=W2,
+        headroom=float(W2), out_headroom=2.0 * W2,
+    )
+    want = _oracle_mean_of_topk(flat_w, ratio)
+    np.testing.assert_allclose(np.asarray(mean)[0], want, rtol=1e-6, atol=1e-7)
+
+
+def test_unaligned_d_padded_tail_exact():
+    """d not divisible by W: the last shard is short, phase-2 top_k can pick
+    zero-padding positions whose global index lands past d — the clipped
+    scatter plus [:d] slice must keep the result exact (padding carries
+    value 0.0, so even the clip target accumulates nothing)."""
+    rng = np.random.default_rng(11)
+    d, ratio = 4090, 0.02  # W*S = 4096 > d: 6-element padded tail
+    assert d % W != 0
+    flat_w = rng.normal(size=(W, d)).astype(np.float32)
+    mean, _ = _run_mode(
+        jnp.asarray(flat_w), ratio, "sparse",
+        headroom=float(W), out_headroom=2.0 * W,
+    )
+    want = _oracle_mean_of_topk(flat_w, ratio)
+    np.testing.assert_allclose(np.asarray(mean)[0], want, rtol=1e-6, atol=1e-7)
+
+
+def test_out_budget_hits_shard_size_cap():
+    """A ratio/headroom combination whose phase-2 budget exceeds the shard
+    size must clamp to it (a shard cannot emit more entries than it has) —
+    and the clamped exchange stays exact when phase-1 budgets are ample."""
+    d, ratio, oh = 4096, 0.5, 4.0
+    S = sparse_rs.shard_size(d, W)
+    assert sparse_rs.out_budget(d, ratio, W, oh) == S  # the cap engaged
+    rng = np.random.default_rng(12)
+    flat_w = rng.normal(size=(W, d)).astype(np.float32)
+    mean, _ = _run_mode(
+        jnp.asarray(flat_w), ratio, "sparse", headroom=float(W), out_headroom=oh,
+    )
+    want = _oracle_mean_of_topk(flat_w, ratio)
+    np.testing.assert_allclose(np.asarray(mean)[0], want, rtol=1e-6, atol=1e-7)
+
+
+# --------------------------------------------------------------------- #
+# r11: the in-collective routes (rs_mode = adaptive / quantized / sketch)
+# --------------------------------------------------------------------- #
+
+
+def test_adaptive_equals_sparse_below_threshold():
+    """The numerical contract of the density switch: at the default
+    threshold (1.0 — strict compare, density <= 1.0 never exceeds it) the
+    adaptive route must produce the SAME mean and own-transmitted arrays
+    as the always-sparse route, bit for bit."""
+    rng = np.random.default_rng(13)
+    d, ratio = 4096, 0.02
+    flat_w = jnp.asarray(rng.normal(size=(W, d)).astype(np.float32))
+    mean_s, own_s = _run_mode(flat_w, ratio, "sparse")
+    mean_a, own_a = _run_mode(flat_w, ratio, "adaptive")
+    np.testing.assert_array_equal(np.asarray(mean_s), np.asarray(mean_a))
+    np.testing.assert_array_equal(np.asarray(own_s), np.asarray(own_a))
+
+
+def test_adaptive_dense_switch_correctness_and_observables():
+    """threshold=0.0 forces every worker's phase-2 row dense: the whole
+    reduced shard travels int8-quantized, so the result must match the
+    UNtruncated phase-1 oracle (no top-K2 loss) within one quantization
+    step per block — and the collect dict must report the switch."""
+    rng = np.random.default_rng(14)
+    d, ratio, block = 4096, 0.02, 256
+    flat_w = rng.normal(size=(W, d)).astype(np.float32)
+    mean, _, density, switches = _run_mode(
+        jnp.asarray(flat_w), ratio, "adaptive", headroom=float(W),
+        density_threshold=0.0, with_collect=True,
+    )
+    got = np.asarray(mean)[0]
+    want = _oracle_mean_of_topk(flat_w, ratio)  # ample headroom: no truncation
+    # per-element quantization tolerance: one step = ||block||_2 / 127 of
+    # the SUMMED shard (= W * want), divided back by W
+    blk = (want * W).reshape(-1, block)
+    tol = np.repeat(np.linalg.norm(blk, axis=1) / 127.0, block) / W
+    assert np.all(np.abs(got - want) <= tol + 1e-6)
+    # every worker saw a live shard and switched dense
+    assert np.all(np.asarray(switches) == 1.0)
+    dens = np.asarray(density)
+    assert np.all(dens > 0.0) and np.all(dens <= 1.0)
+
+
+def test_quantized_mode_error_bounded_by_shared_norms():
+    """The quantized reduce-scatter arm: no sparsifier in phase 1, so on
+    its output support the mean must equal the TRUE dense mean within one
+    stochastic-rounding step against the pmax-shared block norms
+    (levels bounded by 127//W make the int8 psum_scatter sum exact, so
+    quantization is the only error source)."""
+    rng = np.random.default_rng(15)
+    d, ratio, block = 4096, 0.05, 256
+    flat_w = rng.normal(size=(W, d)).astype(np.float32)
+    mean, own = _run_mode(
+        jnp.asarray(flat_w), ratio, "quantized", block_size=block,
+    )
+    got = np.asarray(mean)[0]
+    assert np.allclose(np.asarray(mean), got[None])  # workers agree
+    truth = flat_w.mean(axis=0)
+    q = sparse_rs.quantized_levels_budget(W)
+    # shared scale per block: max over workers of the local block L2 norm;
+    # per-worker rounding error <= norm/q, summed over W then /W
+    norms = np.linalg.norm(flat_w.reshape(W, -1, block), axis=2).max(axis=0)
+    tol = np.repeat(norms / q, block)
+    support = np.nonzero(got)[0]
+    assert support.size > 0
+    assert np.all(np.abs(got[support] - truth[support]) <= tol[support] + 1e-6)
+    assert np.isfinite(np.asarray(own)).all()
+
+
+def test_sketch_mode_recovers_signal_and_feeds_back_own_estimate():
+    """Count-sketch route on identical workers: the psum'd sketch is W x
+    one worker's sketch (linearity), so the decoded mean is the unsketch
+    of a single worker's selection — bounded collision noise — and the
+    own-transmitted EF estimate must agree with the decoded mean on its
+    support (own = unsketch of MY sketch at the same indices)."""
+    rng = np.random.default_rng(16)
+    d, ratio = 4096, 0.01
+    g = np.zeros(d, np.float32)
+    k = sparse.num_slots(d, ratio)
+    hot = rng.choice(d, size=k, replace=False)
+    g[hot] = (rng.normal(size=k) + np.sign(rng.normal(size=k)) * 3.0).astype(
+        np.float32
+    )
+    flat_w = np.tile(g, (W, 1))
+    # collision noise scales as ~‖v‖₂/√C per query, so size the table well
+    # above k (C ≫ k) and give phase 2 headroom for per-shard hot-count
+    # variance — the default C targets wire volume, not exact recovery
+    mean, own = _run_mode(
+        jnp.asarray(flat_w), ratio, "sketch", out_headroom=2.0,
+        sketch_cols=2048,
+    )
+    got = np.asarray(mean)[0]
+    own0 = np.asarray(own)[0]
+    assert np.allclose(np.asarray(mean), got[None])  # workers agree
+    # aggregate signal recovery: collision noise well under the signal
+    rel = np.linalg.norm(got - g * (got != 0)) / np.linalg.norm(g[hot])
+    assert rel < 0.25, rel
+    # EF contract: own == mean on the transmitted support (identical
+    # workers: unsketch(psum)/W == unsketch(own sketch), both linear)
+    support = np.nonzero(got)[0]
+    np.testing.assert_allclose(own0[support], got[support], rtol=1e-4, atol=1e-5)
+
+
+def test_exchange_rejects_unknown_and_unresolved_mode():
+    flat = jnp.zeros((64,), jnp.float32)
+    for mode in ("auto", "bogus"):
+        with pytest.raises(ValueError, match="rs_mode"):
+            sparse_rs.exchange(flat, "data", W, ratio=0.1, rs_mode=mode)
+    for mode in ("adaptive", "quantized"):
+        with pytest.raises(ValueError, match="PRNG key"):
+            sparse_rs.exchange(flat, "data", W, ratio=0.1, rs_mode=mode)
+
+
+# --------------------------------------------------------------------- #
+# r11: config plumbing + auto selection
+# --------------------------------------------------------------------- #
+
+
+def _rs_cfg(**kw):
+    return DeepReduceConfig(
+        compressor="topk", compress_ratio=0.03, memory="none",
+        communicator="sparse_rs", deepreduce=None, **kw,
+    )
+
+
+def test_config_validates_rs_fields():
+    for mode in ("adaptive", "quantized", "sketch", "auto"):
+        assert _rs_cfg(rs_mode=mode).rs_mode == mode
+    with pytest.raises(ValueError, match="rs_mode"):
+        _rs_cfg(rs_mode="bogus")
+    # a non-default rs_mode on a non-sparse_rs communicator would be
+    # silently ignored — must fail loudly instead
+    with pytest.raises(ValueError, match="sparse_rs"):
+        DeepReduceConfig(rs_mode="sketch")
+    with pytest.raises(ValueError, match="multiple of 4"):
+        _rs_cfg(rs_block_size=6)
+    with pytest.raises(ValueError, match="rs_density_threshold"):
+        _rs_cfg(rs_density_threshold=1.5)
+    with pytest.raises(ValueError, match="rs_sketch_rows"):
+        _rs_cfg(rs_sketch_rows=0)
+
+
+def test_resilience_restriction_documents_shard_ownership():
+    """Satellite contract: masks CAN zero a worker's contribution but NOT
+    its shard *ownership* — qar/sparse_rs route shards via static
+    all_to_all/psum_scatter, so a masked owner black-holes its shard. The
+    config must refuse the combination and say why."""
+    for comm_name in ("sparse_rs", "qar"):
+        with pytest.raises(ValueError, match="shard owner"):
+            DeepReduceConfig(
+                compressor="topk" if comm_name == "sparse_rs" else "none",
+                compress_ratio=0.03, memory="none", communicator=comm_name,
+                deepreduce=None, resilience=True,
+            )
+
+
+def test_auto_mode_resolves_via_costmodel():
+    from deepreduce_tpu import costmodel
+
+    d = 8192
+    cfg = _rs_cfg(rs_mode="auto")
+    grads = {"g": jnp.zeros((d,), jnp.float32)}
+    ex = GradientExchanger(grads, cfg, axis_name="data", num_workers=W)
+    want = costmodel.select_rs_mode(
+        d, W, cfg.compress_ratio,
+        headroom=cfg.rs_headroom, out_headroom=cfg.rs_out_headroom,
+        block=cfg.rs_block_size, rows=cfg.rs_sketch_rows,
+        cols=cfg.rs_sketch_cols,
+    )
+    assert ex._rs_mode == want
+    assert ex._rs_mode in sparse_rs.RS_EXCHANGE_MODES
+    # auto without a static worker count cannot price the routes
+    with pytest.raises(ValueError, match="num_workers"):
+        GradientExchanger(grads, cfg, axis_name="data", num_workers=None)
+
+
+def test_payload_bytes_matches_costmodel_per_mode():
+    from deepreduce_tpu import costmodel
+
+    d = 8192
+    grads = {"g": jnp.zeros((d,), jnp.float32)}
+    for mode in sparse_rs.RS_EXCHANGE_MODES:
+        cfg = _rs_cfg(rs_mode=mode)
+        ex = GradientExchanger(grads, cfg, axis_name="data", num_workers=W)
+        want = costmodel.rs_payload_bytes(
+            mode, d, W, cfg.compress_ratio,
+            headroom=cfg.rs_headroom, out_headroom=cfg.rs_out_headroom,
+            block=cfg.rs_block_size, rows=cfg.rs_sketch_rows,
+            cols=cfg.rs_sketch_cols,
+        )
+        assert ex.payload_bytes(grads) == want
+        assert 0 < want < 4 * d * 2
+
+
+def test_trainer_path_quantized_and_sketch_modes():
+    """Full GradientExchanger round for the two non-sparse phase-1 routes:
+    finite aggregates, volume under dense, EF residual retains mass."""
+    rng = np.random.default_rng(17)
+    d = 8192
+    for mode in ("quantized", "sketch"):
+        cfg = DeepReduceConfig(
+            compressor="topk", compress_ratio=0.03, memory="residual",
+            communicator="sparse_rs", deepreduce=None, rs_mode=mode,
+        )
+        grads = {"g": jnp.asarray(rng.normal(size=d).astype(np.float32))}
+        ex = GradientExchanger(grads, cfg, axis_name="data", num_workers=W)
+        state = ex.init_state(grads)
+
+        def spmd(g, res):
+            agg, new_res, stats = ex.exchange(
+                g, res, step=jnp.zeros((), jnp.int32),
+                key=jax.random.PRNGKey(0),
+            )
+            return agg, new_res, stats
+
+        fn = jax.jit(
+            shard_map(
+                spmd, mesh=_mesh(), in_specs=(P(), P()),
+                out_specs=(P(), P(), P()), check_vma=False,
+            )
+        )
+        agg, new_state, stats = fn(grads, state)
+        assert np.isfinite(np.asarray(agg["g"])).all(), mode
+        vol = float(stats.rel_volume())
+        assert 0 < vol < 1.0, (mode, vol)
+        res = np.asarray(jax.tree_util.tree_leaves(new_state)[0])
+        assert np.abs(res).sum() > 0, mode

@@ -192,7 +192,16 @@ def run(args) -> dict:
     model, (kind, spec, classes) = MODELS[args.model]()
 
     n_dev = min(args.num_workers, len(jax.devices()))
-    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
+    if cfg.hier:
+        # hier needs the two-axis (dcn, ici) mesh the Trainer shard_maps over
+        from deepreduce_tpu.parallel import make_hybrid_mesh
+
+        per_slice = cfg.ici_size or max(
+            s for s in range(1, n_dev + 1) if n_dev % s == 0 and s * s <= n_dev
+        )
+        mesh = make_hybrid_mesh(n_dev // per_slice, per_slice)
+    else:
+        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
     trainer = Trainer(
         model, cfg, optax.sgd(args.learning_rate, momentum=0.9), mesh,
         loss_fn=make_loss(kind, model),

@@ -64,6 +64,7 @@ TRACED_MODULES = (
     "deepreduce_tpu/wrappers.py",
     "deepreduce_tpu/resilience/chaos.py",
     "deepreduce_tpu/resilience/faults.py",
+    "deepreduce_tpu/parallel/",
 )
 
 # scope of the mask-host-branch rule: every traced module plus the two

@@ -362,6 +362,113 @@ def audit_exchange(
     return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
 
 
+def audit_hier_mesh(n_slices: int = 2, per_slice: int = 4):
+    """Two-axis (dcn, ici) abstract mesh for the hierarchical audits —
+    same fallback ladder as `audit_mesh`."""
+    try:
+        from jax.sharding import AbstractMesh
+
+        try:
+            return AbstractMesh((("dcn", n_slices), ("ici", per_slice)))
+        except TypeError:  # newer signature: (axis_sizes, axis_names)
+            return AbstractMesh((n_slices, per_slice), ("dcn", "ici"))
+    except ImportError:
+        import numpy as np
+        from jax.sharding import Mesh
+
+        n = n_slices * per_slice
+        devs = jax.devices()
+        if len(devs) < n:
+            raise RuntimeError(
+                f"hier audit needs {n} devices (have {len(devs)}): set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+            )
+        return Mesh(
+            np.array(devs[:n]).reshape(n_slices, per_slice), ("dcn", "ici")
+        )
+
+
+def audit_hier_exchange(
+    label: str,
+    cfg: DeepReduceConfig,
+    *,
+    d: int = 4096,
+    leaves: Optional[Dict[str, int]] = None,
+    expect_by_axis: Optional[Dict[str, Dict[str, int]]] = None,
+    wire_mode: Optional[str] = None,
+    expect_codec: Optional[int] = None,
+    with_key: bool = False,
+    n_slices: int = 2,
+    per_slice: int = 4,
+) -> List[TraceRecord]:
+    """Trace one `HierarchicalExchanger.exchange` step inside shard_map over
+    the two-axis (dcn, ici) mesh and pin the PER-AXIS collective inventory:
+    the slice-reduction leg (and the key-repair gather, when `with_key`)
+    must ride ici only, the compressed leg dcn only, and nothing may touch
+    an axis the contract does not name. Wire accounting runs with
+    `wire_axis='dcn'` — `payload_bytes()` is DCN-only by contract, so only
+    the dcn-leg collective operands may sum to it."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepreduce_tpu.parallel.hierarchical import HierarchicalExchanger
+
+    tmap = jax.tree_util.tree_map
+    mesh = audit_hier_mesh(n_slices, per_slice)
+    axes = ("dcn", "ici")
+    w = n_slices * per_slice
+    if leaves is None:
+        grads_like: Any = _sds((d,))
+    else:
+        grads_like = {n: _sds((int(sz),)) for n, sz in leaves.items()}
+    ex = HierarchicalExchanger(
+        grads_like, cfg, num_slices=n_slices, per_slice=per_slice
+    )
+    pb = ex.payload_bytes(grads_like) if wire_mode is not None else None
+    g_w = tmap(lambda s: _sds((w,) + s.shape), grads_like)
+    with_state = cfg.memory == "residual"
+
+    if with_state:
+
+        def spmd(g, res, step, *key):
+            g0 = tmap(lambda x: x[0], g)
+            res0 = tmap(lambda r: r[0], res)
+            agg, new_res, _ = ex.exchange(
+                g0, res0, step=step, key=key[0] if key else None
+            )
+            new_res = tmap(lambda r: r[None], new_res)
+            return tmap(lambda x: x[None], agg), new_res
+
+        in_specs = (P(axes), P(axes), P()) + ((P(),) if with_key else ())
+        fn = _shard_map(spmd, mesh, in_specs, (P(axes), P(axes)))
+        args = (g_w, g_w, _STEP) + (
+            (_sds((2,), jnp.uint32),) if with_key else ()
+        )
+    else:
+
+        def spmd(g, step, *key):
+            agg, _, _ = ex.exchange(
+                tmap(lambda x: x[0], g), None, step=step,
+                key=key[0] if key else None,
+            )
+            return tmap(lambda x: x[None], agg)
+
+        in_specs = (P(axes), P()) + ((P(),) if with_key else ())
+        fn = _shard_map(spmd, mesh, in_specs, P(axes))
+        args = (g_w, _STEP) + ((_sds((2,), jnp.uint32),) if with_key else ())
+
+    ctx = AuditContext(
+        label=label,
+        allow_callbacks=False,
+        expect_collectives_by_axis=expect_by_axis,
+        wire_mode=wire_mode,
+        expected_wire_bytes=pb,
+        wire_axis="dcn",
+        num_workers=n_slices,
+        expect_codec_invocations=expect_codec,
+    )
+    return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
+
+
 def audit_resilience_off(*, d: int = 4096) -> List[TraceRecord]:
     """Zero-cost-off audit: the flagship fused exchange with every
     resilience knob at its default must trace to a byte-identical jaxpr
@@ -517,6 +624,21 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
     )
     # --- resilience off must be zero-cost (byte-identical trace) ---
     add("resilience:off-identical", lambda: audit_resilience_off())
+    # --- hierarchical flagship: dense ici psum + fused dcn allgather on the
+    # (2, 4) two-axis mesh. The per-axis inventory pins the fabric split —
+    # exactly one psum on ici, exactly one all_gather on dcn, nothing else
+    # anywhere — and the dcn-filtered wire accounting pins payload_bytes()
+    # (DCN-only by contract) against the dcn leg alone ---
+    add(
+        "hier:fused-loop",
+        lambda: audit_hier_exchange(
+            "hier:fused-loop",
+            C(memory="residual", decode_strategy="loop", hier=True, **_FLAGSHIP),
+            expect_by_axis={"ici": {"psum": 1}, "dcn": {"all_gather": 1}},
+            wire_mode="allgather",
+            expect_codec=1,
+        ),
+    )
     if quick:
         return specs
 
@@ -693,6 +815,73 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
             # ONE psum of the [rows, cols] count-sketch (linear, summable)
             # + phase-2 all_gather of the unsketched shard's top-K2
             expect={"psum": 1, "all_gather": 1},
+            wire_mode="collective",
+        ),
+    )
+    # --- remaining hierarchical shapes: every leg combination the planner
+    # can pick, each with its full per-axis inventory ---
+    add(
+        "hier:fused-loop-keyed",
+        lambda: audit_hier_exchange(
+            "hier:fused-loop-keyed",
+            C(memory="residual", decode_strategy="loop", hier=True, **_FLAGSHIP),
+            # the key-repair broadcast is ONE extra tiny all_gather on ici
+            # (replica 0's PRNGKey), never on dcn
+            expect_by_axis={
+                "ici": {"psum": 1, "all_gather": 1},
+                "dcn": {"all_gather": 1},
+            },
+            wire_mode="allgather",
+            with_key=True,
+        ),
+    )
+    add(
+        "hier:qar-ici",
+        lambda: audit_hier_exchange(
+            "hier:qar-ici",
+            C(memory="residual", decode_strategy="loop", hier=True,
+              hier_ici="qar", **_FLAGSHIP),
+            # the int8 quantized allreduce rides ici with its flat inventory
+            # (2 all_to_all + 2 all_gather, exchange:qar above); the dcn leg
+            # is untouched by the ici choice
+            expect_by_axis={
+                "ici": {"all_to_all": 2, "all_gather": 2},
+                "dcn": {"all_gather": 1},
+            },
+            wire_mode="allgather",
+        ),
+    )
+    add(
+        "hier:bucketed-dcn",
+        lambda: audit_hier_exchange(
+            "hier:bucketed-dcn",
+            C(memory="residual", decode_strategy="loop", hier=True,
+              bucket_bytes=_BUCKET_BYTES, **_FLAGSHIP),
+            leaves=_BUCKET_LEAVES,
+            # dense ici reduction is one psum PER LEAF (6); the bucketed dcn
+            # leg keeps its O(buckets) shape: C all_gathers, C codec runs
+            expect_by_axis={
+                "ici": {"psum": len(_BUCKET_LEAVES)},
+                "dcn": {"all_gather": _BUCKET_COUNT},
+            },
+            wire_mode="allgather",
+            expect_codec=_BUCKET_COUNT,
+        ),
+    )
+    add(
+        "hier:quantized-dcn",
+        lambda: audit_hier_exchange(
+            "hier:quantized-dcn",
+            C(communicator="sparse_rs", compressor="topk", memory="none",
+              deepreduce=None, compress_ratio=0.02, rs_mode="quantized",
+              hier=True),
+            # the in-collective quantized route keeps its flat inventory on
+            # dcn (pmax + reduce_scatter + all_gather, exchange:sparse_rs-
+            # quantized above) with the dense slice psum on ici
+            expect_by_axis={
+                "ici": {"psum": 1},
+                "dcn": {"pmax": 1, "reduce_scatter": 1, "all_gather": 1},
+            },
             wire_mode="collective",
         ),
     )

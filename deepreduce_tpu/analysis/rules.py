@@ -118,8 +118,20 @@ class AuditContext:
     budget_scale: Optional[int] = None
     forbid_gather: bool = False
     expect_collectives: Optional[Dict[str, int]] = None
+    # per-axis form of the same contract, for multi-axis (hierarchical)
+    # traces: {axis_name: {prim: exact count}}. Every axis named in the
+    # dict is inventoried exhaustively (unlisted prims must not ride it),
+    # and a collective touching an axis NOT named in the dict is itself a
+    # violation — nothing crosses a fabric the contract doesn't mention.
+    # Independent of `expect_collectives` (flat traces keep the flat form).
+    expect_collectives_by_axis: Optional[Dict[str, Dict[str, int]]] = None
     wire_mode: Optional[str] = None  # 'allgather' | 'ring' | 'collective'
     expected_wire_bytes: Optional[int] = None
+    # restrict wire accounting to collectives riding this mesh axis — the
+    # hierarchical audits pin payload_bytes() (DCN-only by contract)
+    # against the dcn-leg collectives while the ici leg is accounted
+    # separately via WireStats.ici_bits
+    wire_axis: Optional[str] = None
     num_workers: Optional[int] = None
     # exact static count of sparsifier-selection eqns (top_k/approx_top_k):
     # O(leaves) on the per-tensor path, O(buckets) on the bucketed path
@@ -198,6 +210,32 @@ def collective_counts(jaxpr: Any) -> Dict[str, int]:
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS:
             counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+def eqn_axes(eqn: Any) -> tuple:
+    """Mesh axes a collective eqn rides, as a tuple of axis names. JAX
+    spells the param `axis_name` on the data movers (all_gather / ppermute
+    / reduce_scatter / all_to_all) and `axes` on the reducers (psum / pmax
+    / pmin); both may be a single name or a tuple."""
+    axes = eqn.params.get("axis_name", eqn.params.get("axes", ()))
+    if isinstance(axes, (tuple, list)):
+        return tuple(str(a) for a in axes)
+    return (str(axes),)
+
+
+def collective_counts_by_axis(jaxpr: Any) -> Dict[str, Dict[str, int]]:
+    """`collective_counts` split by mesh axis: {axis: {prim: count}}. A
+    collective naming several axes at once (e.g. pmax over ('dcn','ici'))
+    counts once under EACH — it moves data on every fabric it names."""
+    counts: Dict[str, Dict[str, int]] = {}
+    for eqn in walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMS:
+            continue
+        for ax in eqn_axes(eqn):
+            per = counts.setdefault(ax, {})
+            per[name] = per.get(name, 0) + 1
     return counts
 
 
@@ -314,15 +352,29 @@ def rule_collective_inventory(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
     """The fused path is exactly ONE all_gather per step; the ring path is
     ppermute-only; the dense baseline is one psum. Any extra collective is
     a silent regression of the latency story."""
-    if ctx.expect_collectives is None:
-        return []
-    got = collective_counts(jaxpr)
     diffs = []
-    for prim in sorted(set(COLLECTIVE_PRIMS) | set(ctx.expect_collectives)):
-        want = ctx.expect_collectives.get(prim, 0)
-        have = got.get(prim, 0)
-        if want != have:
-            diffs.append(f"{prim}: want {want}, got {have}")
+    if ctx.expect_collectives is not None:
+        got = collective_counts(jaxpr)
+        for prim in sorted(set(COLLECTIVE_PRIMS) | set(ctx.expect_collectives)):
+            want = ctx.expect_collectives.get(prim, 0)
+            have = got.get(prim, 0)
+            if want != have:
+                diffs.append(f"{prim}: want {want}, got {have}")
+    if ctx.expect_collectives_by_axis is not None:
+        by_axis = collective_counts_by_axis(jaxpr)
+        spec = ctx.expect_collectives_by_axis
+        for ax in sorted(set(by_axis) - set(spec)):
+            diffs.append(
+                f"axis {ax!r}: {sum(by_axis[ax].values())} collective(s) on "
+                "an axis the contract does not mention"
+            )
+        for ax in sorted(spec):
+            have_ax = by_axis.get(ax, {})
+            for prim in sorted(set(COLLECTIVE_PRIMS) | set(spec[ax])):
+                want = spec[ax].get(prim, 0)
+                have = have_ax.get(prim, 0)
+                if want != have:
+                    diffs.append(f"{ax}/{prim}: want {want}, got {have}")
     if not diffs:
         return []
     return [
@@ -341,11 +393,17 @@ def rule_wire_accounting(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
     B-byte fused buffer with (W-1)*B == payload_bytes."""
     if ctx.wire_mode is None or ctx.expected_wire_bytes is None:
         return []
+
+    def on_axis(eqn: Any) -> bool:
+        # wire_axis narrows the accounting to one fabric (the hierarchical
+        # audits pin the DCN leg); unset means every collective counts
+        return ctx.wire_axis is None or ctx.wire_axis in eqn_axes(eqn)
+
     if ctx.wire_mode == "allgather":
         moved = sum(
             _aval_bytes(eqn.invars[0].aval)
             for eqn in walk_eqns(jaxpr)
-            if eqn.primitive.name == "all_gather"
+            if eqn.primitive.name == "all_gather" and on_axis(eqn)
         )
         if moved == ctx.expected_wire_bytes:
             return []
@@ -367,7 +425,7 @@ def rule_wire_accounting(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
         moved = sum(
             _aval_bytes(v.aval)
             for eqn in walk_eqns(jaxpr)
-            if eqn.primitive.name in COLLECTIVE_PRIMS
+            if eqn.primitive.name in COLLECTIVE_PRIMS and on_axis(eqn)
             for v in eqn.invars
             if getattr(v, "aval", None) is not None
         )
@@ -386,7 +444,7 @@ def rule_wire_accounting(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
         hop_sizes = {
             _aval_bytes(eqn.invars[0].aval)
             for eqn in walk_eqns(jaxpr)
-            if eqn.primitive.name == "ppermute"
+            if eqn.primitive.name == "ppermute" and on_axis(eqn)
         }
         if not hop_sizes:
             return [

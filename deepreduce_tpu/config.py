@@ -194,6 +194,26 @@ class DeepReduceConfig:
     chaos_drop_rate: float = 0.0      # P(whole payload zeroed — never arrives)
     chaos_corrupt_rate: float = 0.0   # P(random bytes XOR-flipped)
     chaos_truncate_rate: float = 0.0  # P(trailing half of the buffer zeroed)
+    # hierarchical two-axis exchange (parallel/hierarchical.py): reduce the
+    # gradient densely (or int8-quantized) over the fast intra-slice ICI
+    # axis first, then run the compressed exchange this config describes
+    # across slices only, on the scarce DCN axis. The Trainer builds a
+    # (dcn, ici) mesh and shard_maps over both axes when this is on.
+    hier: bool = False
+    # devices per slice = the ici-axis extent. The Trainer needs it to
+    # build the two-axis mesh (dcn extent = device_count // ici_size);
+    # None defers to an explicitly passed two-axis mesh.
+    ici_size: Optional[int] = None
+    # ICI-leg algorithm: 'dense' = f32 psum of the slice mean; 'qar' =
+    # int8 block-quantized allreduce reusing qar.py's bucket helpers
+    # (pays ~9 bits/element on ICI instead of 32); 'auto' = let
+    # costmodel.select_hier_plan argmin both legs at construction.
+    hier_ici: str = "dense"  # dense | qar | auto
+    # DCN-leg selection: 'config' = run exactly the communicator/codec
+    # stack this config describes across slices; 'auto' = rewrite the
+    # cross-slice route to costmodel.select_hier_plan's argmin (fused
+    # allgather vs the sparse_rs routes) at construction.
+    hier_dcn: str = "config"  # config | auto
 
     # the documented enumerations (comments above + codecs/registry.py).
     # __post_init__ checks against these so a typo like
@@ -210,6 +230,8 @@ class DeepReduceConfig:
     POLICIES = ("leftmost", "random", "p0", "conflict_sets", "conflict_sets_approx")
     BLOOM_BLOCKED = (False, True, "hash", "mod")
     RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "auto")
+    HIER_ICI_LEGS = ("dense", "qar", "auto")
+    HIER_DCN_MODES = ("config", "auto")
 
     def __post_init__(self):
         def check(name, value, allowed):
@@ -342,6 +364,70 @@ class DeepReduceConfig:
                 "PayloadLayout wire format and would be silently ignored here "
                 f"(communicator={self.communicator!r}, fused={self.fused}) — "
                 "use fused=True with communicator='allgather'"
+            )
+        # --- hierarchical surface: loud failure for silently-ignored or
+        # --- structurally impossible combinations ---
+        check("hier_ici", self.hier_ici, self.HIER_ICI_LEGS)
+        check("hier_dcn", self.hier_dcn, self.HIER_DCN_MODES)
+        if self.ici_size is not None and self.ici_size < 1:
+            raise ValueError(f"ici_size must be >= 1 or None, got {self.ici_size}")
+        hier_engaged = [
+            name
+            for name, default in (
+                ("ici_size", None),
+                ("hier_ici", "dense"),
+                ("hier_dcn", "config"),
+            )
+            if getattr(self, name) != default
+        ]
+        if hier_engaged and not self.hier:
+            raise ValueError(
+                f"{', '.join(hier_engaged)} configure the hierarchical "
+                "exchange and would be silently ignored with hier=False — "
+                "set hier=True (or drop the knob(s))"
+            )
+        if self.hier and self.decode_strategy == "ring":
+            raise ValueError(
+                "hier=True cannot use decode_strategy='ring': the ring "
+                "decode issues W-1 ppermute hops sized from the FLAT worker "
+                "count, but the hierarchical DCN leg runs over the dcn axis "
+                "only (n_slices workers) — the hop schedule would address "
+                "workers that are ici replicas, not ring peers. Use 'loop' "
+                "or 'vmap' for the cross-slice decode"
+            )
+        if self.hier and self.resilience:
+            # Why the participation mask cannot compose with the two-axis
+            # exchange: the mask contract is per-WORKER, but under hier the
+            # unit of exchange on the DCN axis is a SLICE. The ICI slice
+            # mean is a bare psum with no mask threading — a single dropped
+            # device inside a slice would black-hole into the slice mean
+            # for its ici peers with no renormalization path (the live-count
+            # renorm lives in the DCN-leg exchangers, which only ever see
+            # the already-reduced slice mean). Masking at slice granularity
+            # instead would require a [n_slices] mask agreed across the ici
+            # axis — ownership of "is my slice live" cannot be decided per
+            # device, the same shard-ownership argument that rejects
+            # resilience over sparse_rs. Until the ICI leg learns masked
+            # reduction, the combination fails loudly here.
+            raise ValueError(
+                "resilience=True threads a per-worker participation mask "
+                "through the exchange, but hier=True exchanges per-SLICE on "
+                "the dcn axis: the ici-axis slice mean is an unmasked psum, "
+                "so a dropped device would poison its slice's mean instead "
+                "of degrading gracefully — hierarchical resilience needs "
+                "slice-granular masks, which the per-device contract cannot "
+                "express"
+            )
+        if self.hier and self.hier_dcn == "auto" and (
+            self.deepreduce is not None or self.compressor != "topk"
+        ):
+            raise ValueError(
+                "hier_dcn='auto' rewrites the cross-slice route among the "
+                "plain top-k fused allgather and the sparse_rs routes, all "
+                "of which require compressor='topk' with no deepreduce "
+                f"wrapper — got compressor={self.compressor!r}, "
+                f"deepreduce={self.deepreduce!r}. Use hier_dcn='config' to "
+                "run this codec stack across slices as-is"
             )
         if self.fault_plan is not None:
             # syntax check at construction (deferred import: faults.py is

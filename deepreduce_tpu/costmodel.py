@@ -212,6 +212,12 @@ def rs_step_time(
     return wire + t_compute_s
 
 
+def _rs_kw(kw: Dict) -> Dict:
+    """Filter **kw down to the keys rs_wire_bytes understands."""
+    keep = ("headroom", "out_headroom", "block", "rows", "cols")
+    return {k: kw[k] for k in keep if k in kw}
+
+
 def select_rs_mode(
     d: int,
     W: int,
@@ -241,3 +247,156 @@ def select_rs_mode(
         if t < best_t:
             best, best_t = mode, t
     return best
+
+
+# ---------------------------------------------------------------------------
+# Two-tier (ICI x DCN) hierarchical model.
+#
+# A multi-slice mesh has two links with a ~100x bandwidth gap: the
+# intra-slice ICI fabric and the cross-slice DCN. The hierarchical
+# exchange reduces densely (or int8-quantized) over the fast axis first,
+# then runs one of the flat compressed exchanges across slices only.
+# Modeled step time is the SUM of the two legs — the slice mean must
+# complete before the DCN leg can start, so the legs serialize.
+# ---------------------------------------------------------------------------
+
+# 10 Gbps in bytes/s — a deliberately conservative stand-in for the
+# intra-slice fabric (real ICI is far faster; the planner only needs the
+# order-of-magnitude gap against the 100 Mbps DCN default).
+BW_ICI_10GBPS = 1.25e9
+
+HIER_ICI_LEGS = ("dense", "qar")
+HIER_DCN_LEGS = ("fused", "bucketed", "sparse", "adaptive", "quantized", "sketch")
+
+
+def qar_wire_bytes_per_worker(d: int, W: int, block: int = 512) -> float:
+    """Per-worker wire bytes of the int8 two-phase quantized allreduce.
+
+    Mirrors ``qar.wire_bits_per_worker`` (kept numerically identical by
+    tests/test_hierarchical.py) without importing jax: two tiled
+    all_to_all phases of int8 levels plus two all_gathers of f32 bucket
+    norms, each moving the (W-1)/W ring fraction."""
+    n = quantized_padded_len(d, W, block)
+    bits = 2.0 * (n * 8 + (n // block) * 32) * (W - 1) / W
+    return bits / 8.0
+
+
+def hier_ici_time(
+    leg: str, d: int, per_slice: int, bw_ici: float = BW_ICI_10GBPS,
+    *, block: int = 512,
+) -> float:
+    """Modeled ICI-leg time: dense f32 psum or int8 quantized allreduce
+    over the `per_slice` devices of one slice."""
+    if per_slice <= 1:
+        return 0.0
+    if leg == "dense":
+        return allreduce_time(4.0 * d, per_slice, bw_ici)
+    if leg == "qar":
+        return qar_wire_bytes_per_worker(d, per_slice, block) / bw_ici
+    raise ValueError(f"unknown ici leg {leg!r} (expected one of {HIER_ICI_LEGS})")
+
+
+def hier_dcn_time(
+    leg: str,
+    d: int,
+    n_slices: int,
+    ratio: float,
+    bw_dcn: float = BW_100MBPS,
+    *,
+    measurement: Optional[Dict[str, float]] = None,
+    t_compute_s: float = 0.0,
+    **kw,
+) -> float:
+    """Modeled DCN-leg time with `n_slices` workers on the scarce link.
+
+    "fused"/"bucketed" use the allgather model; without a measured codec
+    row the payload defaults to 8 bytes/entry at k = d*ratio (the same
+    value+index convention rs_wire_bytes uses). "bucketed" overlaps
+    decode under the next bucket's gather, so it pays max(wire, decode)
+    instead of their sum; with no measured compute the two tie and the
+    planner's candidate order prefers plain "fused"."""
+    if leg in ("fused", "bucketed"):
+        m = measurement or {
+            "payload_bytes": 8.0 * max(1, int(d * ratio)),
+            "t_encode_s": 0.0,
+            "t_decode_s": 0.0,
+        }
+        wire = allgather_time(m["payload_bytes"], n_slices, bw_dcn)
+        if leg == "bucketed":
+            return m["t_encode_s"] + max(wire, n_slices * m["t_decode_s"])
+        return m["t_encode_s"] + wire + n_slices * m["t_decode_s"]
+    return rs_step_time(
+        leg, d, n_slices, ratio, t_compute_s=t_compute_s, bw=bw_dcn, **_rs_kw(kw)
+    )
+
+
+def hier_step_time(
+    ici: str,
+    dcn: str,
+    d: int,
+    n_slices: int,
+    per_slice: int,
+    ratio: float,
+    *,
+    bw_ici: float = BW_ICI_10GBPS,
+    bw_dcn: float = BW_100MBPS,
+    ici_block: int = 512,
+    measurement: Optional[Dict[str, float]] = None,
+    t_compute_s: float = 0.0,
+    **kw,
+) -> float:
+    """Modeled step time of one (ici, dcn) plan: serialized two-leg sum."""
+    return hier_ici_time(ici, d, per_slice, bw_ici, block=ici_block) + hier_dcn_time(
+        dcn, d, n_slices, ratio, bw_dcn,
+        measurement=measurement, t_compute_s=t_compute_s, **kw,
+    )
+
+
+def select_hier_plan(
+    d: int,
+    n_slices: int,
+    per_slice: int,
+    ratio: float,
+    bw_ici: float = BW_ICI_10GBPS,
+    bw_dcn: float = BW_100MBPS,
+    *,
+    ici_block: int = 512,
+    ici_legs: Optional[tuple] = None,
+    dcn_legs: Optional[tuple] = None,
+    measurements: Optional[Dict[str, Dict[str, float]]] = None,
+    compute: Optional[Dict[str, float]] = None,
+    **kw,
+) -> Dict:
+    """Construction-time auto-planner: argmin of `hier_step_time` over
+    {ici: dense|qar} x {dcn: fused|bucketed|rs modes}.
+
+    Deterministic from static shapes and config alone, like
+    `select_rs_mode`; bench.py --hier-sweep optionally supplies measured
+    codec rows (`measurements[dcn_leg]` -> flat measurement dict) and
+    per-route compute (`compute[dcn_leg]` seconds) so its report and the
+    planner argmin over exactly the same numbers.
+
+    Returns {"ici", "dcn", "modeled_step_s", "table"} where table maps
+    "ici+dcn" -> modeled seconds for every candidate pair."""
+    ici_cands = ici_legs or HIER_ICI_LEGS
+    dcn_cands = dcn_legs or HIER_DCN_LEGS
+    table: Dict[str, float] = {}
+    best = None
+    for dcn in dcn_cands:
+        m = (measurements or {}).get(dcn)
+        tc = (compute or {}).get(dcn, 0.0)
+        for ici in ici_cands:
+            t = hier_step_time(
+                ici, dcn, d, n_slices, per_slice, ratio,
+                bw_ici=bw_ici, bw_dcn=bw_dcn, ici_block=ici_block,
+                measurement=m, t_compute_s=tc, **kw,
+            )
+            table[f"{ici}+{dcn}"] = t
+            if best is None or t < table[f"{best[0]}+{best[1]}"]:
+                best = (ici, dcn)
+    return {
+        "ici": best[0],
+        "dcn": best[1],
+        "modeled_step_s": table[f"{best[0]}+{best[1]}"],
+        "table": table,
+    }

@@ -17,6 +17,7 @@ from typing import Any, Dict, Iterator
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @jax.tree_util.register_dataclass
@@ -36,10 +37,29 @@ class WireStats:
     saturated: jax.Array = dataclasses.field(
         default_factory=lambda: jnp.zeros((), jnp.float32)
     )
+    # bits this device moved on the intra-slice ICI fabric (hierarchical
+    # exchange only: the slice-mean psum/qar leg plus the key-repair
+    # all_gather, ring-adjusted like costmodel's per-collective terms).
+    # index_bits/value_bits remain the scarce-link (flat axis or DCN)
+    # accounting — total_bits deliberately excludes this counter, so every
+    # pre-hier rel_volume number keeps its meaning. The default is a HOST
+    # numpy scalar, not jnp.zeros: a jnp constant built while a trace is
+    # active is itself a Tracer, and summing Tracers in `combine` would
+    # stage an `add 0 0` into every flat-exchange jaxpr — which the
+    # committed ANALYSIS.json trace hashes pin byte-identical.
+    ici_bits: jax.Array = dataclasses.field(
+        default_factory=lambda: np.zeros((), np.float32)
+    )
 
     @property
     def total_bits(self) -> jax.Array:
         return self.index_bits + self.value_bits
+
+    @property
+    def dcn_bits(self) -> jax.Array:
+        """Alias for the scarce-link volume (index + value bits): what the
+        hierarchical exchange moves across DCN, i.e. `total_bits`."""
+        return self.total_bits
 
     def rel_volume(self) -> jax.Array:
         return self.total_bits.astype(jnp.float32) / self.dense_bits.astype(jnp.float32)
@@ -54,11 +74,22 @@ class WireStats:
 def combine(stats: Dict[str, WireStats]) -> WireStats:
     """Sum wire stats across a gradient pytree's tensors."""
     vals = list(stats.values())
+    # ici_bits is only ever set by the hierarchical exchange, AFTER this
+    # per-tensor combine — inside the flat exchanges every instance holds
+    # its concrete default zero. Summing those on the host (instead of
+    # through staged jnp adds) keeps every pre-hier jaxpr byte-identical,
+    # which ANALYSIS.json's committed trace hashes pin.
+    ici = [s.ici_bits for s in vals]
+    if any(isinstance(x, jax.core.Tracer) for x in ici):
+        ici_sum = sum(ici)
+    else:
+        ici_sum = np.float32(sum(float(x) for x in ici))
     return WireStats(
         index_bits=sum(s.index_bits for s in vals),
         value_bits=sum(s.value_bits for s in vals),
         dense_bits=sum(s.dense_bits for s in vals),
         saturated=sum(s.saturated for s in vals),
+        ici_bits=ici_sum,
     )
 
 

@@ -7,63 +7,86 @@ joined by DCN (the scarce link — the role the reference's 100 Mbps
 simulated-FL link plays in paper Table 4). Compression belongs on the
 scarce link only:
 
-    1. dense `psum` of gradients over the `ici` axis — full-precision
-       slice mean, rides ICI where bandwidth is nearly free;
-    2. compressed exchange (any DeepReduce codec config) over the `dcn`
-       axis — the usual sparsify/encode/all_gather/decode/aggregate, with
-       wire accounting now measuring exactly the bytes that cross DCN.
+    1. slice reduction over the `ici` axis — either a dense full-precision
+       `psum` or the int8 two-phase quantized allreduce (qar.py), selected
+       by ``cfg.hier_ici``; rides ICI where bandwidth is nearly free;
+    2. compressed exchange over the `dcn` axis — any of the framework's
+       cross-worker routes: the fused allgather stack (per-tensor or
+       `BucketedExchanger` when ``bucket_bytes`` is set), the sparse_rs
+       in-collective routes (including `quantized`/`adaptive`), dense
+       allreduce, or qar. ``cfg.hier_dcn='auto'`` lets
+       `costmodel.select_hier_plan` rewrite the route at construction.
 
 Every device in a slice enters step 2 with the identical slice-mean
 gradient and the same PRNG key, so all ICI replicas of a DCN group run the
 same deterministic exchange and agree bit-for-bit — no second broadcast is
 needed (the decode-side determinism contract that the bloom policies
 already guarantee, bloom_filter_compression.cc:217-218).
+
+Wire accounting is split by fabric: `payload_bytes()` / WireStats
+index+value bits stay DCN-only (the scarce-link numbers every committed
+bench compares), while the ICI leg (slice psum or qar phases, plus the
+key-repair all_gather) is reported under the separate `WireStats.ici_bits`
+counter and the `exchange/ici` span.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepreduce_tpu import costmodel, qar
 from deepreduce_tpu.comm import GradientExchanger
 from deepreduce_tpu.config import DeepReduceConfig
 from deepreduce_tpu.metrics import WireStats
+from deepreduce_tpu.parallel.mesh import make_mesh
+from deepreduce_tpu.telemetry import spans
 
 
 def make_hybrid_mesh(n_slices: int, per_slice: int,
                      dcn_axis: str = "dcn", ici_axis: str = "ici"):
-    """(dcn, ici) mesh. On real multi-slice hardware prefer
-    `mesh_utils.create_hybrid_device_mesh` (DCN-aware device order); on a
-    single slice / virtual CPU mesh a plain reshape is the right layout."""
-    from jax.sharding import Mesh
+    """(dcn, ici) mesh — thin alias over the one mesh factory.
 
-    devices = jax.devices()
-    need = n_slices * per_slice
-    if len(devices) < need:
-        raise ValueError(f"need {need} devices, have {len(devices)}")
-    try:  # DCN-aware layout when more than one real slice exists
-        from jax.experimental import mesh_utils
+    `make_mesh(..., dcn_axis=...)` owns the DCN-aware device layout
+    (`mesh_utils.create_hybrid_device_mesh`) and the refuse-silent-fallback
+    guard for real multi-slice device sets."""
+    return make_mesh(
+        {dcn_axis: n_slices, ici_axis: per_slice}, dcn_axis=dcn_axis
+    )
 
-        arr = mesh_utils.create_hybrid_device_mesh(
-            (per_slice,), (n_slices,), devices=devices[:need]
-        ).reshape(n_slices, per_slice)
-    except Exception as e:
-        # On real multi-slice hardware a wrong layout inverts the bandwidth
-        # premise (dense psum would cross DCN) — never fall back silently.
-        if any(getattr(dev, "slice_index", 0) for dev in devices[:need]):
-            raise RuntimeError(
-                "multi-slice device set but DCN-aware mesh construction "
-                f"failed ({e!r}); refusing a slice-oblivious layout"
-            ) from e
-        arr = np.array(devices[:need]).reshape(n_slices, per_slice)
-    return Mesh(arr, (dcn_axis, ici_axis))
+
+def _total_elems(grads_like: Any) -> int:
+    return sum(
+        int(np.prod(leaf.shape)) if leaf.shape else 1
+        for leaf in jax.tree_util.tree_leaves(grads_like)
+    )
+
+
+def _cfg_dcn_leg(cfg: DeepReduceConfig, d: int, n_slices: Optional[int]) -> Optional[str]:
+    """The cost-model leg name of the DCN route this config describes, or
+    None when the route has no model row (allreduce / qar across DCN)."""
+    if cfg.communicator == "sparse_rs":
+        if cfg.rs_mode != "auto":
+            return cfg.rs_mode
+        if n_slices is None:
+            return None
+        return costmodel.select_rs_mode(
+            d, n_slices, cfg.compress_ratio,
+            headroom=cfg.rs_headroom, out_headroom=cfg.rs_out_headroom,
+            block=cfg.rs_block_size, rows=cfg.rs_sketch_rows,
+            cols=cfg.rs_sketch_cols,
+        )
+    if cfg.communicator == "allgather":
+        return "bucketed" if cfg.bucket_bytes else "fused"
+    return None
 
 
 class HierarchicalExchanger:
-    """ICI-dense + DCN-compressed exchange. Same call contract as
+    """ICI-reduce + DCN-compressed exchange. Same call contract as
     `GradientExchanger.exchange`, for use inside shard_map over BOTH axes.
 
     Correctness contract: every ICI replica within a slice must run the
@@ -72,19 +95,102 @@ class HierarchicalExchanger:
     contract by construction — `exchange` replaces each replica's key
     with ICI-replica 0's key (one tiny all_gather over the ici axis), so
     a caller that accidentally folds the ici position into the key still
-    gets bit-identical encodes across the slice."""
+    gets bit-identical encodes across the slice.
+
+    With ``cfg.hier_ici='auto'`` or ``cfg.hier_dcn='auto'`` the
+    construction-time planner (`costmodel.select_hier_plan`) argmins the
+    two legs jointly; a chosen DCN route rewrites the inner exchanger's
+    config (e.g. to ``communicator='sparse_rs', rs_mode='quantized'``),
+    and the winning plan is exposed as ``self.plan`` so drivers/bench can
+    report it."""
 
     def __init__(self, grads_like: Any, cfg: DeepReduceConfig, *,
                  dcn_axis: str = "dcn", ici_axis: str = "ici",
-                 num_slices: Optional[int] = None):
+                 num_slices: Optional[int] = None,
+                 per_slice: Optional[int] = None):
+        self.cfg = cfg
         self.ici_axis = ici_axis
         self.dcn_axis = dcn_axis
+        self.num_slices = num_slices
+        self.per_slice = per_slice
+        d = _total_elems(grads_like)
+        self.ici_leg = cfg.hier_ici
+        self.plan: Optional[Dict] = None
+        inner_cfg = cfg
+        if "auto" in (cfg.hier_ici, cfg.hier_dcn):
+            if num_slices is None or per_slice is None:
+                raise ValueError(
+                    "hier auto-planning needs the static mesh split: "
+                    "construct HierarchicalExchanger(..., num_slices="
+                    "mesh.shape['dcn'], per_slice=mesh.shape['ici'])"
+                )
+            if cfg.hier_dcn == "auto":
+                # candidate cross-slice routes the planner may rewrite to.
+                # bucketed and fused share the allgather wire model; offer
+                # whichever the config can express (bucket_bytes set or not)
+                # so the rewrite never invents a bucket partition.
+                dcn_legs = (("bucketed",) if cfg.bucket_bytes else ("fused",)) + (
+                    "sparse", "adaptive", "quantized", "sketch",
+                )
+            else:
+                leg = _cfg_dcn_leg(cfg, d, num_slices)
+                if leg is None:
+                    raise ValueError(
+                        "hier_ici='auto' needs a cost-modelable DCN leg to "
+                        "argmin against, but "
+                        f"communicator={cfg.communicator!r} has no "
+                        "cross-slice model row — pick hier_ici explicitly"
+                    )
+                dcn_legs = (leg,)
+            self.plan = costmodel.select_hier_plan(
+                d, num_slices, per_slice, cfg.compress_ratio,
+                ici_block=cfg.bucket_size,
+                ici_legs=None if cfg.hier_ici == "auto" else (cfg.hier_ici,),
+                dcn_legs=dcn_legs,
+                headroom=cfg.rs_headroom, out_headroom=cfg.rs_out_headroom,
+                block=cfg.rs_block_size, rows=cfg.rs_sketch_rows,
+                cols=cfg.rs_sketch_cols,
+            )
+            if cfg.hier_ici == "auto":
+                self.ici_leg = self.plan["ici"]
+            if cfg.hier_dcn == "auto":
+                leg = self.plan["dcn"]
+                if leg in ("fused", "bucketed"):
+                    inner_cfg = dataclasses.replace(
+                        cfg, communicator="allgather", rs_mode="sparse"
+                    )
+                else:
+                    inner_cfg = dataclasses.replace(
+                        cfg, communicator="sparse_rs", rs_mode=leg,
+                        bucket_bytes=None,
+                    )
+        self.inner_cfg = inner_cfg
         self.exchanger = GradientExchanger(
-            grads_like, cfg, axis_name=dcn_axis, num_workers=num_slices
+            grads_like, inner_cfg, axis_name=dcn_axis, num_workers=num_slices
         )
+
+    # --- surface the GradientExchanger attributes drivers consume -------- #
+
+    @property
+    def axis_name(self):
+        """Both mesh axes — the loss/metric pmean in make_worker_step must
+        average over every device, not just the dcn groups."""
+        return (self.dcn_axis, self.ici_axis)
+
+    @property
+    def num_workers(self) -> Optional[int]:
+        if self.num_slices is None or self.per_slice is None:
+            return None
+        return self.num_slices * self.per_slice
+
+    @property
+    def num_buckets(self) -> int:
+        return self.exchanger.num_buckets
 
     def init_state(self, grads_like: Any) -> Any:
         return self.exchanger.init_state(grads_like)
+
+    # --- the exchange ----------------------------------------------------- #
 
     def exchange(
         self,
@@ -93,24 +199,95 @@ class HierarchicalExchanger:
         *,
         step: jax.Array = 0,
         key: Optional[jax.Array] = None,
+        collect: Optional[dict] = None,
+        mask: Optional[jax.Array] = None,
     ) -> Tuple[Any, Any, WireStats]:
-        n_ici = jax.lax.psum(1, self.ici_axis)
-        slice_mean = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, self.ici_axis) / n_ici, grads
+        if mask is not None:
+            raise ValueError(
+                "hierarchical exchange takes no participation mask (the ici "
+                "slice mean is an unmasked psum; config rejects "
+                "resilience=True with hier=True)"
+            )
+        n_ici = jax.lax.psum(1, self.ici_axis)  # static mesh-axis size
+        ici_bits = 0.0
+        with spans.span("exchange/ici"):
+            if self.ici_leg == "qar":
+                from jax.flatten_util import ravel_pytree
+
+                flat, unravel = ravel_pytree(grads)
+                d = flat.shape[0]
+                n = qar.pad_len(d, n_ici, self.cfg.bucket_size)
+                padded = flat.astype(jnp.float32)
+                if n > d:
+                    padded = jnp.zeros((n,), jnp.float32).at[:d].set(padded)
+                kq = key if key is not None else jax.random.PRNGKey(step)
+                mean = qar.quantized_allreduce(
+                    padded, self.ici_axis, n_ici,
+                    key=kq,
+                    quantum_num=self.cfg.quantum_num,
+                    bucket_size=self.cfg.bucket_size,
+                    use_pallas=self.cfg.use_pallas,
+                )
+                slice_mean = unravel(mean[:d].astype(flat.dtype))
+                ici_bits += qar.wire_bits_per_worker(d, n_ici, self.cfg.bucket_size)
+            else:
+                slice_mean = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, self.ici_axis) / n_ici, grads
+                )
+                if n_ici > 1:
+                    d = _total_elems(grads)
+                    ici_bits += 2.0 * (n_ici - 1) / n_ici * 32.0 * d
+            # enforce the class contract: every ICI replica of a DCN group
+            # runs the identical stochastic encode. Broadcast replica 0's
+            # key over the ici axis (identity when the caller already passed
+            # a shared key; repairs an accidentally position-folded key).
+            if key is not None:
+                if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):  # typed key
+                    kdata = jax.random.key_data(key)
+                    ici_bits += kdata.size * 32.0 * (n_ici - 1)
+                    kdata = jax.lax.all_gather(kdata, self.ici_axis)[0]
+                    key = jax.random.wrap_key_data(
+                        kdata, impl=jax.random.key_impl(key)
+                    )
+                else:  # raw uint32 PRNGKey array
+                    ici_bits += key.size * 32.0 * (n_ici - 1)
+                    key = jax.lax.all_gather(key, self.ici_axis)[0]
+        with spans.span("exchange/dcn"):
+            agg, new_state, wire = self.exchanger.exchange(
+                slice_mean, state, step=step, key=key, collect=collect
+            )
+        return agg, new_state, dataclasses.replace(
+            wire,
+            ici_bits=wire.ici_bits + jnp.asarray(ici_bits, jnp.float32),
         )
-        # enforce the class contract: every ICI replica of a DCN group runs
-        # the identical stochastic encode. Broadcast replica 0's key over
-        # the ici axis (identity when the caller already passed a shared
-        # key; repairs an accidentally position-folded key).
-        if key is not None:
-            if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):  # typed key
-                kdata = jax.lax.all_gather(jax.random.key_data(key), self.ici_axis)[0]
-                key = jax.random.wrap_key_data(kdata, impl=jax.random.key_impl(key))
-            else:  # raw uint32 PRNGKey array
-                key = jax.lax.all_gather(key, self.ici_axis)[0]
-        return self.exchanger.exchange(slice_mean, state, step=step, key=key)
+
+    # --- accounting -------------------------------------------------------- #
 
     def payload_bytes(self, grads_like: Any) -> int:
-        """Bytes crossing DCN per device per step (ICI psum not counted —
-        it is the cheap link by construction)."""
+        """Bytes crossing DCN per device per step — DCN-only BY CONTRACT.
+
+        The ICI leg (slice-mean psum or qar phases) and the key-repair
+        all_gather never touch the scarce link and are deliberately
+        excluded here so this number stays comparable with every flat
+        exchange's `payload_bytes()`. ICI traffic is accounted separately:
+        statically via `ici_payload_bytes()`, and per step under the
+        `WireStats.ici_bits` counter the exchange returns."""
         return self.exchanger.payload_bytes(grads_like)
+
+    def ici_payload_bytes(self, grads_like: Any,
+                          per_slice: Optional[int] = None) -> float:
+        """Ring-adjusted bytes one device moves on the ICI fabric per step
+        for the slice-reduction leg (excludes the ~8-byte key-repair
+        gather, which only exists when the caller passes a key)."""
+        p = per_slice if per_slice is not None else self.per_slice
+        if p is None:
+            raise ValueError(
+                "ici_payload_bytes needs the static slice size: pass "
+                "per_slice= here or at construction"
+            )
+        d = _total_elems(grads_like)
+        if self.ici_leg == "qar":
+            return qar.wire_bits_per_worker(d, p, self.cfg.bucket_size) / 8.0
+        if p <= 1:
+            return 0.0
+        return 2.0 * (p - 1) / p * 4.0 * d

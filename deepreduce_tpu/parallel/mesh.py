@@ -42,12 +42,49 @@ def factor_devices(n: int, axes: Sequence[str]) -> Dict[str, int]:
 def make_mesh(
     axes: Dict[str, int],
     devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    dcn_axis: Optional[str] = None,
 ) -> Mesh:
     """Mesh from {axis_name: size}. Sizes must multiply to the device count
-    used. `make_mesh({'data': 4, 'seq': 2})` on 8 devices."""
+    used. `make_mesh({'data': 4, 'seq': 2})` on 8 devices.
+
+    `dcn_axis` names the axis that crosses the inter-slice DCN link (it
+    must be the LEADING axis, so the remaining axes stay inside a slice).
+    When set, the device layout comes from
+    `mesh_utils.create_hybrid_device_mesh` — on real multi-slice hardware
+    that places each mesh row within one slice, which is the entire
+    bandwidth premise of the hierarchical exchange. On a single slice or a
+    virtual CPU mesh, where hybrid construction cannot apply, a plain
+    reshape is the right layout; but if the device set spans real slices
+    and DCN-aware construction fails, this raises instead of silently
+    handing back a slice-oblivious layout (a wrong layout would route the
+    dense psum over DCN — inverting the premise, not degrading it)."""
     shape: Tuple[int, ...] = tuple(axes.values())
     n = int(np.prod(shape))
     devs = list(devices) if devices is not None else jax.devices()[:n]
     if len(devs) != n:
         raise ValueError(f"need {n} devices for mesh {axes}, have {len(devs)}")
-    return Mesh(np.asarray(devs).reshape(shape), tuple(axes.keys()))
+    names = tuple(axes.keys())
+    if dcn_axis is None:
+        return Mesh(np.asarray(devs).reshape(shape), names)
+    if names[0] != dcn_axis:
+        raise ValueError(
+            f"dcn_axis={dcn_axis!r} must be the leading mesh axis, got "
+            f"axis order {names}"
+        )
+    n_slices = axes[dcn_axis]
+    per_slice = n // max(1, n_slices)
+    try:  # DCN-aware layout when more than one real slice exists
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (per_slice,), (n_slices,), devices=devs
+        ).reshape(shape)
+    except Exception as e:
+        if any(getattr(dev, "slice_index", 0) for dev in devs):
+            raise RuntimeError(
+                "multi-slice device set but DCN-aware mesh construction "
+                f"failed ({e!r}); refusing a slice-oblivious layout"
+            ) from e
+        arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, names)

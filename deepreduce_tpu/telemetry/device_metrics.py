@@ -54,6 +54,11 @@ class MetricAccumulators:
     # divide both by `steps` on the host for the running rates
     rs_density: jax.Array
     rs_dense_switches: jax.Array
+    # hierarchical exchange: Σ per-step bits one device moved on the
+    # intra-slice ICI fabric (slice-mean psum/qar leg + key repair). Stays
+    # 0.0 in flat exchanges; the scarce-link (flat/DCN) volume remains in
+    # index_bits/value_bits, so rel_volume keeps its pre-hier meaning
+    ici_bits: jax.Array
     # Σ per-BUCKET saturation counts, f32[C] in bucket-spec order for the
     # bucketed exchange (f32[0] when unbucketed) — keeps one chronically
     # overfull bucket visible next to the summed `saturated` total
@@ -103,6 +108,7 @@ class MetricAccumulators:
             checksum_failures=self.checksum_failures + f(checksum_failures),
             rs_density=self.rs_density + f(rs_density),
             rs_dense_switches=self.rs_dense_switches + f(rs_dense_switches),
+            ici_bits=self.ici_bits + f(wire.ici_bits),
             # broadcasts: [C] + [C] per-step vector, or [C] + 0.0 when the
             # caller has nothing to report this step (and [0] + 0.0 when
             # unbucketed — a no-op on the empty vector)
@@ -157,4 +163,9 @@ class MetricAccumulators:
             # phase-1 reduce, and the dense-row switch rate
             "rs_density_per_step": vals["rs_density"] / steps,
             "rs_dense_switch_rate": vals["rs_dense_switches"] / steps,
+            # hierarchical exchange: per-step per-device bytes on each
+            # fabric (dcn = the scarce-link index+value volume above)
+            "ici_bytes_per_step": vals["ici_bits"] / 8.0 / steps,
+            "dcn_bytes_per_step": (vals["index_bits"] + vals["value_bits"])
+            / 8.0 / steps,
         }

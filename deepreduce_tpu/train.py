@@ -23,6 +23,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -132,6 +133,12 @@ def make_worker_step(
             # saturation is a COUNT (summed, not averaged): total saturated
             # tensor payloads across all workers this step
             saturated=jax.lax.psum(wire.saturated.astype(jnp.float32), axis),
+            # ICI-fabric bits are a static per-device count (identical on
+            # every device), so no collective: a concrete 0.0 in flat
+            # exchanges, which keeps this line out of pre-hier jaxprs
+            ici_bits=jnp.asarray(wire.ici_bits, jnp.float32)
+            if isinstance(wire.ici_bits, jax.core.Tracer)
+            else np.float32(wire.ici_bits),
         )
         new_state = TrainState(
             params=new_params,
@@ -217,7 +224,7 @@ class Trainer:
         model,
         cfg: DeepReduceConfig,
         optimizer: optax.GradientTransformation,
-        mesh: Mesh,
+        mesh: Optional[Mesh] = None,
         *,
         axis_name: str = "data",
         loss_fn: Optional[Callable] = None,
@@ -225,8 +232,46 @@ class Trainer:
         self.model = model
         self.cfg = cfg
         self.optimizer = optimizer
+        if cfg.hier:
+            # hierarchical mode runs over a two-axis (dcn, ici) mesh. Build
+            # it from cfg.ici_size when none is passed (the one mesh factory
+            # owns the DCN-aware layout), or validate a caller-supplied mesh
+            # actually has both axes — a flat mesh here would silently
+            # collapse the hierarchy.
+            from deepreduce_tpu.parallel.hierarchical import make_hybrid_mesh
+
+            if mesh is None:
+                if cfg.ici_size is None:
+                    raise ValueError(
+                        "hier=True with no mesh needs cfg.ici_size to split "
+                        "the devices into (dcn, ici); set ici_size or pass a "
+                        "two-axis mesh"
+                    )
+                n_dev = len(jax.devices())
+                if n_dev % cfg.ici_size:
+                    raise ValueError(
+                        f"ici_size={cfg.ici_size} does not divide the "
+                        f"device count {n_dev}"
+                    )
+                mesh = make_hybrid_mesh(n_dev // cfg.ici_size, cfg.ici_size)
+            else:
+                missing = {"dcn", "ici"} - set(mesh.axis_names)
+                if missing:
+                    raise ValueError(
+                        f"hier=True needs a (dcn, ici) mesh; the given mesh "
+                        f"lacks axis(es) {sorted(missing)}"
+                    )
+                if cfg.ici_size is not None and mesh.shape["ici"] != cfg.ici_size:
+                    raise ValueError(
+                        f"cfg.ici_size={cfg.ici_size} contradicts the given "
+                        f"mesh's ici extent {mesh.shape['ici']}"
+                    )
+            self.axis_name = ("dcn", "ici")
+        else:
+            if mesh is None:
+                raise ValueError("a mesh is required when cfg.hier is False")
+            self.axis_name = axis_name
         self.mesh = mesh
-        self.axis_name = axis_name
         self.loss_fn = loss_fn or classification_loss(model)
         self.exchanger: Optional[GradientExchanger] = None
         self._step_fn = None
@@ -235,6 +280,11 @@ class Trainer:
 
     @property
     def num_workers(self) -> int:
+        if isinstance(self.axis_name, tuple):
+            n = 1
+            for a in self.axis_name:
+                n *= self.mesh.shape[a]
+            return n
         return self.mesh.shape[self.axis_name]
 
     def init_state(self, rng: jax.Array, sample_batch) -> TrainState:
@@ -245,9 +295,19 @@ class Trainer:
             variables = self.model.init(rng, sample_input)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
-        self.exchanger = GradientExchanger(
-            params, self.cfg, axis_name=self.axis_name, num_workers=self.num_workers
-        )
+        if self.cfg.hier:
+            from deepreduce_tpu.parallel.hierarchical import HierarchicalExchanger
+
+            self.exchanger = HierarchicalExchanger(
+                params, self.cfg,
+                num_slices=self.mesh.shape["dcn"],
+                per_slice=self.mesh.shape["ici"],
+            )
+        else:
+            self.exchanger = GradientExchanger(
+                params, self.cfg, axis_name=self.axis_name,
+                num_workers=self.num_workers,
+            )
         residuals = self.exchanger.init_state(params)
         if residuals is not None:
             # worker-local residual: leading [num_workers] axis, sharded

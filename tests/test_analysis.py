@@ -187,6 +187,43 @@ def test_two_collective_fused_exchange_caught():
     assert "all_gather" in v.detail
 
 
+def test_per_axis_collective_inventory_caught():
+    """The per-axis form of jx-collective-count: a psum that rides the dcn
+    axis when the contract puts it on ici is caught, and so is any
+    collective on an axis the contract does not mention."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepreduce_tpu.analysis.jaxpr_audit import audit_hier_mesh
+
+    mesh = audit_hier_mesh(2, 4)
+
+    def spmd(x):
+        # slice reduction on the WRONG axis (dcn instead of ici), plus the
+        # gather the contract expects on dcn
+        m = jax.lax.psum(x[0], "dcn") / 2.0
+        return jax.lax.all_gather(m, "dcn").sum(axis=0)[None]
+
+    fn = shard_map(spmd, mesh=mesh, in_specs=(P(("dcn", "ici")),),
+                   out_specs=P(("dcn", "ici")), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, 128), jnp.float32))
+    ctx = AuditContext(
+        label="fixture:axis-swap",
+        expect_collectives_by_axis={
+            "ici": {"psum": 1}, "dcn": {"all_gather": 1},
+        },
+    )
+    v = _only(run_rules(closed, ctx), rules.R_COLLECTIVE_COUNT)
+    assert "ici/psum" in v.detail and "dcn/psum" in v.detail
+
+    # an axis the contract does not mention is itself a violation
+    ctx2 = AuditContext(
+        label="fixture:unmentioned-axis",
+        expect_collectives_by_axis={"ici": {"psum": 1, "all_gather": 1}},
+    )
+    v2 = _only(run_rules(closed, ctx2), rules.R_COLLECTIVE_COUNT)
+    assert "does not mention" in v2.detail
+
+
 def test_gather_in_mod_query_caught():
     def bad_query(words, idxs):
         return words[idxs]  # a gather in what must be a broadcast path

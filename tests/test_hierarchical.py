@@ -23,7 +23,9 @@ def _grads():
 
 def _run(cfg, grads):
     mesh = make_hybrid_mesh(N_SLICES, PER_SLICE)
-    hx = HierarchicalExchanger({"w": jnp.zeros((D,))}, cfg)
+    hx = HierarchicalExchanger(
+        {"w": jnp.zeros((D,))}, cfg, num_slices=N_SLICES, per_slice=PER_SLICE
+    )
     state0 = hx.init_state({"w": jnp.zeros((D,))})
 
     def spmd(g):
@@ -137,3 +139,297 @@ def test_folded_key_repaired_across_ici_replicas(key_style):
     out = np.asarray(fn(_grads())).reshape(N_SLICES * PER_SLICE, D)
     for row in out[1:]:
         np.testing.assert_array_equal(row, out[0])
+
+
+# ---------------------------------------------------------------------- #
+# flat equivalence: per_slice=1 degenerates to the flat exchange, bitwise
+# ---------------------------------------------------------------------- #
+
+
+def _run_flat(cfg, grads, like):
+    """The same exchange over a flat 8-way mesh via GradientExchanger."""
+    from jax.sharding import Mesh
+
+    from deepreduce_tpu.comm import GradientExchanger
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    ex = GradientExchanger(like, cfg, axis_name="data", num_workers=8)
+    tmap = jax.tree_util.tree_map
+
+    def spmd(g):
+        g0 = tmap(lambda x: x.reshape(x.shape[1:]), g)
+        agg, _, _ = ex.exchange(
+            g0, None, step=jnp.zeros((), jnp.int32), key=jax.random.PRNGKey(7)
+        )
+        return tmap(lambda x: x[None], agg)
+
+    fn = jax.jit(
+        shard_map(spmd, mesh=mesh, in_specs=(P("data"),),
+                  out_specs=P("data"), check_vma=False)
+    )
+    return fn(grads)
+
+
+def _run_hier_degenerate(cfg, grads, like):
+    """The same exchange as a per_slice=1 hierarchy: 8 slices of 1 device."""
+    mesh = make_hybrid_mesh(8, 1)
+    hx = HierarchicalExchanger(like, cfg, num_slices=8, per_slice=1)
+    tmap = jax.tree_util.tree_map
+
+    def spmd(g):
+        g0 = tmap(lambda x: x.reshape(x.shape[1:]), g)
+        agg, _, _ = hx.exchange(
+            g0, None, step=jnp.zeros((), jnp.int32), key=jax.random.PRNGKey(7)
+        )
+        return tmap(lambda x: x[None], agg)
+
+    fn = jax.jit(
+        shard_map(spmd, mesh=mesh, in_specs=(P(("dcn", "ici")),),
+                  out_specs=P(("dcn", "ici")), check_vma=False)
+    )
+    return fn(grads)
+
+
+@pytest.mark.parametrize(
+    "name,extra",
+    [
+        ("loop", dict(decode_strategy="loop")),
+        ("vmap", dict(decode_strategy="vmap", decode_batch=4)),
+        # stochastic value codec: any key divergence between the two paths
+        # would break bitwise equality immediately
+        ("qsgd", dict(deepreduce="value", value="qsgd")),
+    ],
+)
+def test_flat_equivalence_per_slice_one(name, extra):
+    """A per_slice=1 hierarchy IS the flat exchange: the ici psum averages
+    one device (exact), the key repair broadcasts over a singleton axis
+    (identity), and the dcn leg is the flat communicator verbatim — so the
+    outputs must agree BITWISE, including under a stochastic codec."""
+    base = dict(
+        compressor="topk", compress_ratio=0.25, deepreduce="index",
+        index="bloom", policy="p0", fpr=0.01, memory="none",
+        min_compress_size=64,
+    )
+    if "deepreduce" in extra:
+        base = dict(compressor="topk", compress_ratio=0.25, memory="none",
+                    min_compress_size=64)
+    flat_cfg = DeepReduceConfig(**base, **extra)
+    hier_cfg = DeepReduceConfig(**base, **extra, hier=True)
+    grads = {"w": _grads()}
+    like = {"w": jnp.zeros((D,))}
+    flat = _run_flat(flat_cfg, grads, like)
+    hier = _run_hier_degenerate(hier_cfg, grads, like)
+    np.testing.assert_array_equal(np.asarray(flat["w"]), np.asarray(hier["w"]))
+
+
+def test_flat_equivalence_bucketed():
+    """Same degenerate-hierarchy contract on the bucketed exchange: the
+    multi-leaf FFD-partitioned payload path must also be bitwise equal."""
+    leaves = {"emb": 3000, "w1": 900, "b1": 300}
+    base = dict(
+        compressor="topk", compress_ratio=0.25, deepreduce="index",
+        index="bloom", policy="p0", fpr=0.01, memory="none",
+        min_compress_size=64, bucket_bytes=4800,
+    )
+    flat_cfg = DeepReduceConfig(**base)
+    hier_cfg = DeepReduceConfig(**base, hier=True)
+    rng = np.random.default_rng(1)
+    grads = {
+        n: jnp.asarray(rng.normal(size=(8, sz)).astype(np.float32))
+        for n, sz in leaves.items()
+    }
+    like = {n: jnp.zeros((sz,)) for n, sz in leaves.items()}
+    flat = _run_flat(flat_cfg, grads, like)
+    hier = _run_hier_degenerate(hier_cfg, grads, like)
+    for n in leaves:
+        np.testing.assert_array_equal(np.asarray(flat[n]), np.asarray(hier[n]))
+
+
+# ---------------------------------------------------------------------- #
+# the composed legs on the (2, 4) mesh
+# ---------------------------------------------------------------------- #
+
+
+def test_qar_ici_leg_agrees_and_approximates_mean():
+    """int8 quantized slice reduction + dense DCN allreduce: all 8 devices
+    agree bitwise and land within quantization error of the global mean."""
+    cfg = DeepReduceConfig(
+        compressor="none", deepreduce=None, memory="none",
+        communicator="allreduce", hier=True, hier_ici="qar",
+    )
+    grads = _grads()
+    out, wire = _run(cfg, grads)
+    for row in out[1:]:
+        np.testing.assert_array_equal(row, out[0])
+    want = np.asarray(grads).mean(axis=0)
+    # two int8 phases over buckets of |max| <= ~4 sigma: generous bound
+    assert float(np.abs(out[0] - want).max()) < 0.2
+    assert float(np.asarray(wire.ici_bits)) > 0.0
+
+
+def test_bucketed_dcn_leg_on_two_axis_mesh():
+    """bucket_bytes routes the DCN leg through BucketedExchanger under the
+    hierarchy: all devices agree, and the DCN payload stays compressed."""
+    leaves = {"emb": 3000, "w1": 900, "b1": 300}
+    cfg = DeepReduceConfig(
+        compressor="topk", compress_ratio=0.25, deepreduce="index",
+        index="bloom", policy="p0", fpr=0.01, memory="none",
+        min_compress_size=64, bucket_bytes=4800, hier=True,
+    )
+    mesh = make_hybrid_mesh(N_SLICES, PER_SLICE)
+    rng = np.random.default_rng(2)
+    grads = {
+        n: jnp.asarray(rng.normal(size=(8, sz)).astype(np.float32))
+        for n, sz in leaves.items()
+    }
+    like = {n: jnp.zeros((sz,)) for n, sz in leaves.items()}
+    hx = HierarchicalExchanger(like, cfg, num_slices=N_SLICES, per_slice=PER_SLICE)
+    tmap = jax.tree_util.tree_map
+
+    def spmd(g):
+        g0 = tmap(lambda x: x.reshape(x.shape[1:]), g)
+        agg, _, wire = hx.exchange(
+            g0, None, step=jnp.zeros((), jnp.int32), key=jax.random.PRNGKey(7)
+        )
+        return tmap(lambda x: x[None], agg), wire
+
+    fn = jax.jit(
+        shard_map(spmd, mesh=mesh, in_specs=(P(("dcn", "ici")),),
+                  out_specs=(P(("dcn", "ici")), P()), check_vma=False)
+    )
+    out, wire = fn(grads)
+    for n in leaves:
+        rows = np.asarray(out[n])
+        for row in rows[1:]:
+            np.testing.assert_array_equal(row, rows[0])
+    assert 0 < float(wire.rel_volume()) < 1.0
+    d_total = sum(leaves.values())
+    assert 0 < hx.payload_bytes(like) < d_total * 4
+
+
+def test_quantized_rs_dcn_leg_on_two_axis_mesh():
+    """The in-collective quantized reduce-scatter as the DCN leg: devices
+    agree bitwise; ici accounting stays separate from the dcn volume."""
+    cfg = DeepReduceConfig(
+        compressor="topk", compress_ratio=0.25, memory="none",
+        deepreduce=None, communicator="sparse_rs", rs_mode="quantized",
+        hier=True,
+    )
+    grads = _grads()
+    out, wire = _run(cfg, grads)
+    for row in out[1:]:
+        np.testing.assert_array_equal(row, out[0])
+    assert np.isfinite(out).all()
+    # dense slice psum on ici: 2(p-1)/p * 32d bits per device
+    assert float(np.asarray(wire.ici_bits)) > 0.0
+    assert 0 < float(wire.rel_volume()) < 1.0
+
+
+def test_auto_plan_rewrites_inner_route():
+    """hier_dcn='auto' at the headline shape rewrites the inner exchanger
+    to the planner's pick and exposes the plan."""
+    from deepreduce_tpu import costmodel
+
+    cfg = DeepReduceConfig(
+        compressor="topk", compress_ratio=0.10, memory="none",
+        deepreduce=None, hier=True, hier_ici="auto", hier_dcn="auto",
+    )
+    d = 4_053_428
+    hx = HierarchicalExchanger(
+        jax.ShapeDtypeStruct((d,), jnp.float32), cfg,
+        num_slices=8, per_slice=4,
+    )
+    plan = costmodel.select_hier_plan(d, 8, 4, 0.10)
+    assert hx.plan["ici"] == plan["ici"] == hx.ici_leg
+    assert hx.plan["dcn"] == plan["dcn"]
+    if plan["dcn"] in ("fused", "bucketed"):
+        assert hx.inner_cfg.communicator == "allgather"
+    else:
+        assert hx.inner_cfg.communicator == "sparse_rs"
+        assert hx.inner_cfg.rs_mode == plan["dcn"]
+
+
+# ---------------------------------------------------------------------- #
+# config validation surface
+# ---------------------------------------------------------------------- #
+
+
+def test_config_rejects_hier_with_ring_decode():
+    with pytest.raises(ValueError, match="ring"):
+        DeepReduceConfig(
+            compressor="topk", compress_ratio=0.1, deepreduce="index",
+            index="bloom", memory="residual", decode_strategy="ring",
+            hier=True,
+        )
+
+
+def test_config_rejects_hier_with_resilience():
+    with pytest.raises(ValueError, match="resilience"):
+        DeepReduceConfig(
+            compressor="topk", compress_ratio=0.1, memory="residual",
+            resilience=True, hier=True,
+        )
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [dict(ici_size=4), dict(hier_ici="qar"), dict(hier_dcn="auto")],
+)
+def test_config_rejects_hier_knobs_without_hier(kw):
+    with pytest.raises(ValueError, match="hier"):
+        DeepReduceConfig(compressor="topk", compress_ratio=0.1, **kw)
+
+
+def test_config_rejects_bad_hier_enums():
+    with pytest.raises(ValueError):
+        DeepReduceConfig(hier=True, hier_ici="bogus")
+    with pytest.raises(ValueError):
+        DeepReduceConfig(hier=True, hier_dcn="bogus")
+    with pytest.raises(ValueError):
+        DeepReduceConfig(hier=True, ici_size=0)
+
+
+def test_config_rejects_hier_dcn_auto_with_pinned_codec():
+    with pytest.raises(ValueError, match="auto"):
+        DeepReduceConfig(
+            compressor="topk", compress_ratio=0.1, deepreduce="index",
+            index="bloom", hier=True, hier_dcn="auto",
+        )
+
+
+# ---------------------------------------------------------------------- #
+# cost model
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("d,W,block", [(4096, 4, 512), (4_053_428, 4, 512),
+                                       (100_000, 8, 256), (77, 2, 512)])
+def test_costmodel_qar_wire_mirror(d, W, block):
+    """costmodel.qar_wire_bytes_per_worker (jax-free, used by the planner)
+    must stay numerically identical to qar.wire_bits_per_worker/8 (the
+    traced accounting the exchange adds to WireStats.ici_bits)."""
+    from deepreduce_tpu import costmodel, qar
+
+    want = qar.wire_bits_per_worker(d, W, block) / 8.0
+    got = costmodel.qar_wire_bytes_per_worker(d, W, block)
+    assert got == pytest.approx(want)
+
+
+def test_select_hier_plan_headline_shape():
+    """At the committed BENCH_HIER shape (8 slices x 4, LSTM d, top-10%,
+    100 Mbps DCN / 10 Gbps ICI) the planner picks qar+quantized and the
+    plan beats every flat compressed arm paying the DCN link 32-wide."""
+    from deepreduce_tpu import costmodel as cm
+
+    d, ratio = 4_053_428, 0.10
+    plan = cm.select_hier_plan(d, 8, 4, ratio)
+    assert (plan["ici"], plan["dcn"]) == ("qar", "quantized")
+    assert len(plan["table"]) == len(cm.HIER_ICI_LEGS) * len(cm.HIER_DCN_LEGS)
+    best_flat = min(
+        cm.rs_step_time(m, d, 32, ratio)
+        for m in ("sparse", "adaptive", "quantized", "sketch")
+    )
+    assert plan["modeled_step_s"] < best_flat
+    # per_slice=1 degenerates: the ici leg costs nothing, any ici choice ties
+    p1 = cm.select_hier_plan(d, 8, 1, ratio)
+    assert p1["table"]["dense+quantized"] == p1["table"]["qar+quantized"]

@@ -1,12 +1,25 @@
-.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check
+.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check fedsim-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
 # violation. CPU-only, trace-only (no compiles). Also exercises the
-# telemetry round trip (telemetry-check) and the resilience smoke
-# (chaos-check) so neither path can rot while the gate stays green.
-analyze: telemetry-check chaos-check
+# telemetry round trip (telemetry-check), the resilience smoke
+# (chaos-check) and the federated round smoke (fedsim-check) so none of
+# those paths can rot while the gate stays green.
+analyze: telemetry-check chaos-check fedsim-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
+
+# federated-simulation smoke: a small client-sharded cohort run on the
+# 8-device CPU mesh with FaultPlan churn + wire corruption under payload
+# checksums — asserts convergence, recorded churn/checksum failures, and a
+# BITWISE mid-run checkpoint resume; then the telemetry CLI digests the
+# tracked run dir (clients/sec + uplink-bytes rows).
+FEDSIM_CHECK_DIR := /tmp/drtpu_fedsim_check
+fedsim-check:
+	rm -rf $(FEDSIM_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.fedsim --platform cpu check \
+		--track_dir $(FEDSIM_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry summary $(FEDSIM_CHECK_DIR)/check
 
 # resilience smoke: a short 8-worker CPU-mesh train under a FaultPlan drop
 # schedule + wire corruption with payload checksums — asserts finite,

@@ -891,6 +891,103 @@ def hier_sweep(quick: bool = False, n_slices: int = 8, per_slice: int = 4) -> di
     }
 
 
+def fed_sweep(quick: bool = False, workers: int = 8) -> dict:
+    """The federated serving sweep arm (`--fed-sweep`): the client-sharded
+    `fedsim` round on the virtual 8-way CPU mesh, swept over cohort sizes
+    against a fixed 10^5-scale population — the ROADMAP's clients/sec
+    serving bench. Each arm builds the full round program (in-step
+    stratified sampling, vmapped local SGD + real TensorCodec uplinks with
+    per-client EF against the device-sharded residual bank, ONE psum), runs
+    one compile round plus timed rounds, and reports measured clients/sec
+    next to the 100 Mbps cost-model pricing (`costmodel.fed_round_time`) —
+    CPU wall time measures the simulator's serving rate; the model prices
+    what the same uplink volume costs a real scarce-link deployment."""
+    import jax
+    import optax
+    from jax.sharding import Mesh
+
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.fedsim.sim import FedSim, synthetic_linear_problem
+    from deepreduce_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    cm = _costmodel()
+    population = 1 << 17 if not quick else 1 << 12  # 131072 clients
+    cohorts = (1024, 4096, 16384) if not quick else (256, 512)
+    dim, batch, local_steps = 256, 4, 2
+    chunk = 128 if not quick else 32  # divides every per-worker cohort
+    rounds = 4  # 1 compile + 3 timed
+    mesh = Mesh(np.array(jax.devices()[:workers]), ("data",))
+    params0, data_fn, loss_fn = synthetic_linear_problem(dim, batch, local_steps)
+    arms = {}
+    for C in cohorts:
+        cfg = DeepReduceConfig(
+            deepreduce="index", index="bloom", bloom_blocked="mod",
+            compress_ratio=0.25, fpr=0.01, memory="residual",
+            min_compress_size=8,
+            fed=True, fed_num_clients=population, fed_clients_per_round=C,
+            fed_local_steps=local_steps,
+        )
+        fed = cfg.fed_config()
+        fs = FedSim(
+            loss_fn, cfg, fed, optax.sgd(0.1), data_fn,
+            mesh=mesh, client_chunk=chunk,
+        )
+        _progress(f"fed-sweep: C={C} (pop {population}): compiling round")
+        with _span(f"bench/fed-sweep/compile/C{C}"):
+            state = fs.init(params0)
+            key = jax.random.PRNGKey(0)
+            state, m = fs.step(state, jax.random.fold_in(key, 0))
+        _progress(f"fed-sweep: C={C}: timing {rounds - 1} rounds")
+        with _span(f"bench/fed-sweep/time/C{C}"):
+            for r in range(1, rounds):
+                state, m = fs.step(state, jax.random.fold_in(key, r))
+        summ = fs.summary(state)
+        up_round = float(m["uplink_bytes"])
+        up_client = up_round / max(float(m["clients"]), 1.0)
+        modeled_t = cm.fed_round_time(up_client, C)
+        arms[f"C{C}"] = {
+            "clients_per_round": C,
+            "measured_round_s": round(summ["round_time_s"], 4),
+            "measured_clients_per_sec": round(summ["clients_per_sec"], 1),
+            "uplink_bytes_per_round": round(up_round, 1),
+            "uplink_bytes_per_client": round(up_client, 1),
+            "downlink_bytes": round(float(m["downlink_bytes"]), 1),
+            "rel_volume": round(float(m["rel_volume"]), 4),
+            "modeled_100mbps_round_s": round(modeled_t, 4),
+            "modeled_100mbps_clients_per_sec": round(
+                cm.fed_clients_per_sec(up_client, C), 1
+            ),
+        }
+        _progress(
+            f"fed-sweep: C={C}: {arms[f'C{C}']['measured_clients_per_sec']} "
+            "clients/s measured"
+        )
+    best = max(arms, key=lambda k: arms[k]["measured_clients_per_sec"])
+    return {
+        "metric": "fedsim_serving_clients_per_sec",
+        "value": arms[best]["measured_clients_per_sec"],
+        "unit": "clients/s",
+        "platform": "cpu",
+        "detail": {
+            "population": population,
+            "dim": dim,
+            "batch": batch,
+            "local_steps": local_steps,
+            "workers": workers,
+            "client_chunk": chunk,
+            "codec": "topk 25% + mod-blocked bloom, per-client EF residual bank",
+            "bw_bytes_per_s": cm.BW_100MBPS,
+            "cost_model": (
+                "server-ingest-serialized uplink (costmodel.fed_round_time); "
+                "simulation measured on the 8-way virtual CPU mesh"
+            ),
+            "best_cohort": best,
+            "cohorts": arms,
+        },
+    }
+
+
 def main() -> None:
     if _trace_out_path():
         from deepreduce_tpu.telemetry import spans
@@ -930,6 +1027,14 @@ def main() -> None:
 
         force_platform("cpu")
         print(json.dumps(hier_sweep(quick="--quick" in sys.argv)))
+        return
+    if "--fed-sweep" in sys.argv:
+        # standalone federated serving sweep: CPU-mesh only, one JSON
+        # record on stdout (committed as BENCH_FED_*.json)
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu", device_count=8)
+        print(json.dumps(fed_sweep(quick="--quick" in sys.argv)))
         return
     if "--rs-sweep" in sys.argv:
         # standalone in-collective sweep mode: CPU-mesh only, one JSON

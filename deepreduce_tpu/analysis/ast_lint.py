@@ -65,6 +65,7 @@ TRACED_MODULES = (
     "deepreduce_tpu/resilience/chaos.py",
     "deepreduce_tpu/resilience/faults.py",
     "deepreduce_tpu/parallel/",
+    "deepreduce_tpu/fedsim/",
 )
 
 # scope of the mask-host-branch rule: every traced module plus the two

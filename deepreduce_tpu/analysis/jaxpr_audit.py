@@ -511,6 +511,64 @@ def audit_resilience_off(*, d: int = 4096) -> List[TraceRecord]:
     return [check_off_identical("resilience:off-identical", make_fn, args, patches)]
 
 
+def audit_fedsim_round(*, d: int = 512) -> List[TraceRecord]:
+    """The federated round's cross-worker traffic, pinned: the whole round
+    (S2C broadcast compression, in-step stratified cohort sampling, vmapped
+    client local-train + uplink compression, bank scatter, server update)
+    contracts to exactly ONE psum — the tuple (update sums, wire bits, live
+    count, checksum failures) — and the operand bytes of that psum are
+    exactly 4*(param_elements + 6) B/worker. Codec count pins TWO top-k
+    selections: one S2C delta encode + one vmapped C2S client encode (the
+    cohort shares a single traced selection, however many clients run)."""
+    import optax
+
+    from deepreduce_tpu.fedsim.sim import FedSim, synthetic_linear_problem
+
+    tmap = jax.tree_util.tree_map
+    cfg = DeepReduceConfig(
+        memory="residual",
+        fed=True,
+        fed_num_clients=64,
+        fed_clients_per_round=16,
+        fed_local_steps=2,
+        **_FLAGSHIP,
+    )
+    fed = cfg.fed_config()
+    params0, data_fn, loss_fn = synthetic_linear_problem(d, 4, fed.local_steps)
+    fs = FedSim(
+        loss_fn, cfg, fed, optax.sgd(0.1), data_fn, mesh=audit_mesh(), axis=AXIS
+    )
+    fn = fs.sharded_round_fn()
+    params_sds = tmap(lambda p: _sds(p.shape, p.dtype), params0)
+    bank_sds = tmap(
+        lambda p: _sds((fed.num_clients,) + p.shape, p.dtype), params_sds
+    )
+    n_elems = sum(
+        int(jnp.prod(jnp.array(p.shape))) if p.shape else 1
+        for p in jax.tree_util.tree_leaves(params_sds)
+    )
+    # psum tuple = param-leaf update sums + wire4 (4 scalars) + nlive + nfail
+    pb = 4 * (n_elems + 6)
+    args = (
+        params_sds,  # params (replicated)
+        params_sds,  # w_ref (replicated)
+        bank_sds,  # residual bank, P(axis) on dim 0
+        None,  # telemetry accumulators (off)
+        _STEP,  # round counter
+        _sds((2,), jnp.uint32),  # round key
+    )
+    ctx = AuditContext(
+        label="fedsim:round",
+        allow_callbacks=False,
+        expect_collectives={"psum": 1},
+        wire_mode="collective",
+        expected_wire_bytes=pb,
+        num_workers=NUM_WORKERS,
+        expect_codec_invocations=2,
+    )
+    return [trace_and_check("fedsim:round", fn, args, ctx, payload_bytes=pb)]
+
+
 def _per_tensor_expected_gathers(cfg: DeepReduceConfig, d: int) -> int:
     """fused=False issues one all_gather per payload *leaf* (all_gather maps
     over the pytree) — the static count is the leaf count."""
@@ -885,6 +943,9 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
             wire_mode="collective",
         ),
     )
+    # --- the federated round: one psum, exact wire accounting, two codec
+    # invocations (S2C delta + the shared vmapped C2S client encode) ---
+    add("fedsim:round", lambda: audit_fedsim_round())
     add(
         "codec:countsketch",
         lambda: audit_codec(

@@ -214,6 +214,27 @@ class DeepReduceConfig:
     # cross-slice route to costmodel.select_hier_plan's argmin (fused
     # allgather vs the sparse_rs routes) at construction.
     hier_dcn: str = "config"  # config | auto
+    # federated simulation subsystem (deepreduce_tpu.fedsim): population-
+    # scale FedAvg rounds — cohorts sampled per round, sharded over the mesh
+    # worker axis, executed as vmapped client batches inside one jitted
+    # round step. Off by default; the knobs below describe the round
+    # geometry the drivers (fedsim CLI, bench --fed-sweep) build their
+    # `FedConfig` from.
+    fed: bool = False
+    # population size: total simulated clients, each holding a persistent
+    # per-client error-feedback residual row in the device-sharded bank
+    fed_num_clients: int = 0
+    # cohort size: clients sampled (without replacement) per round; must
+    # divide evenly across the mesh worker axis at driver construction
+    fed_clients_per_round: int = 0
+    # local SGD steps per sampled client per round (paper §6.2 E)
+    fed_local_steps: int = 1
+    # server-side step size applied to the renormalized cohort mean
+    fed_server_lr: float = 1.0
+    # peak-memory bound for the vmapped cohort: > 0 scans over blocks of
+    # this many vmapped clients per worker instead of one [C_local, ...]
+    # batch (must divide the per-worker cohort). 0 = single vmap block.
+    fed_client_chunk: int = 0
 
     # the documented enumerations (comments above + codecs/registry.py).
     # __post_init__ checks against these so a typo like
@@ -435,6 +456,79 @@ class DeepReduceConfig:
             from deepreduce_tpu.resilience.faults import FaultPlan
 
             FaultPlan.parse(self.fault_plan)
+        # --- federated surface: loud failure for silently-ignored knobs ---
+        fed_engaged = [
+            name
+            for name, default in (
+                ("fed_num_clients", 0),
+                ("fed_clients_per_round", 0),
+                ("fed_local_steps", 1),
+                ("fed_server_lr", 1.0),
+                ("fed_client_chunk", 0),
+            )
+            if getattr(self, name) != default
+        ]
+        if fed_engaged and not self.fed:
+            raise ValueError(
+                f"{', '.join(fed_engaged)} configure the federated "
+                "simulation subsystem and would be silently ignored with "
+                "fed=False — set fed=True (or drop the knob(s))"
+            )
+        if self.fed:
+            # geometry checks mirror FedConfig.__post_init__ so a bad round
+            # shape fails at config construction, not at driver build
+            if self.fed_num_clients <= 0:
+                raise ValueError(
+                    "fed=True requires a positive fed_num_clients "
+                    f"population, got {self.fed_num_clients}"
+                )
+            if self.fed_clients_per_round <= 0:
+                raise ValueError(
+                    "fed=True requires a positive fed_clients_per_round "
+                    f"cohort, got {self.fed_clients_per_round}"
+                )
+            if self.fed_clients_per_round > self.fed_num_clients:
+                raise ValueError(
+                    f"fed_clients_per_round={self.fed_clients_per_round} "
+                    f"exceeds fed_num_clients={self.fed_num_clients} — "
+                    "cohorts are sampled without replacement"
+                )
+            if self.fed_local_steps <= 0:
+                raise ValueError(
+                    f"fed_local_steps must be positive, got {self.fed_local_steps}"
+                )
+            if self.fed_server_lr <= 0:
+                raise ValueError(
+                    f"fed_server_lr must be positive, got {self.fed_server_lr}"
+                )
+            if self.fed_client_chunk < 0:
+                raise ValueError(
+                    "fed_client_chunk must be >= 0 (0 = one vmap block), "
+                    f"got {self.fed_client_chunk}"
+                )
+            if (
+                self.fed_client_chunk > 0
+                and self.fed_clients_per_round % self.fed_client_chunk
+            ):
+                raise ValueError(
+                    f"fed_client_chunk={self.fed_client_chunk} must divide "
+                    f"fed_clients_per_round={self.fed_clients_per_round} "
+                    "(the chunked cohort scan needs equal blocks)"
+                )
+
+    def fed_config(self):
+        """The round-geometry view of the fed_* knobs (deferred import:
+        fedsim.round imports this module, so no cycle at import time)."""
+        if not self.fed:
+            raise ValueError("fed_config() requires fed=True")
+        from deepreduce_tpu.fedsim.round import FedConfig
+
+        return FedConfig(
+            num_clients=self.fed_num_clients,
+            clients_per_round=self.fed_clients_per_round,
+            local_steps=self.fed_local_steps,
+            server_lr=self.fed_server_lr,
+        )
 
     @classmethod
     def tpu_defaults(cls, **overrides) -> "DeepReduceConfig":

@@ -95,6 +95,56 @@ def fused_step_time(
 
 
 # ---------------------------------------------------------------------------
+# Federated round-time model (fedsim): the paper's deployment setting is C
+# client uplinks per round into one parameter server behind a shared ingest
+# link. Client local compute runs in parallel across clients (it bounds the
+# first arrival, not the total), so the round wall time is ingest-serialized:
+# every live uplink's compressed payload crosses the server's link(s), plus
+# the one S2C broadcast going out. This is what makes DeepReduce's uplink
+# compression a *clients/sec* multiplier — the serving capacity of one
+# server link scales inversely with per-client payload bytes.
+# ---------------------------------------------------------------------------
+
+
+def fed_round_time(
+    uplink_bytes_per_client: float,
+    clients: int,
+    bw: float = BW_100MBPS,
+    *,
+    t_client_s: float = 0.0,
+    downlink_bytes: float = 0.0,
+    server_links: int = 1,
+) -> float:
+    """Wall seconds of one federated round at `clients` live uplinks.
+    `t_client_s` is one client's local-train latency (paid once — clients
+    compute concurrently); `server_links` models ingest parallelism."""
+    wire = clients * uplink_bytes_per_client + downlink_bytes
+    return t_client_s + wire / (bw * max(server_links, 1))
+
+
+def fed_clients_per_sec(
+    uplink_bytes_per_client: float,
+    clients: int,
+    bw: float = BW_100MBPS,
+    *,
+    t_client_s: float = 0.0,
+    downlink_bytes: float = 0.0,
+    server_links: int = 1,
+) -> float:
+    """Served clients per second at the modeled round time — the serving
+    throughput the ROADMAP's million-user scenario is priced in."""
+    t = fed_round_time(
+        uplink_bytes_per_client,
+        clients,
+        bw,
+        t_client_s=t_client_s,
+        downlink_bytes=downlink_bytes,
+        server_links=server_links,
+    )
+    return clients / max(t, 1e-12)
+
+
+# ---------------------------------------------------------------------------
 # Per-rs_mode static wire accounting. These return the per-worker
 # *injection* bytes of every collective the route issues — the same
 # numbers GradientExchanger.payload_bytes() reports and the

@@ -24,11 +24,13 @@ Design notes (TPU-native, vs the reference's 57-VM AWS testbed):
   from it only by not-yet-delivered delta mass. This keeps state O(model), not
   O(clients x model) — except the per-client C2S residuals, which are the
   price of client-side error feedback (paper keeps these on each device).
-- The round is ONE `lax.scan` over the stacked client axis (each body
-  iteration is itself a `lax.scan` over local steps), so the compiled
-  program size is independent of the number of sampled clients — the
-  paper's 56-client rounds compile exactly one copy of
-  local-train + codec.
+- The round *body* — local train, per-client compression with EF, cohort
+  aggregation with churn masking — lives in `fedsim.round` and is shared
+  with the population-scale `fedsim.FedSim` driver. This harness keeps
+  the proven scalar path: ONE `lax.scan` over the stacked client axis
+  (`impl="scan"`), so the compiled program size is independent of the
+  number of sampled clients. `impl="vmap"` runs the same body batched
+  (tests pin the two equivalent).
 """
 
 from __future__ import annotations
@@ -41,20 +43,17 @@ import jax.numpy as jnp
 import optax
 
 from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.fedsim.codec_tree import TreeCodec
+from deepreduce_tpu.fedsim.round import (  # noqa: F401  (FedConfig re-export)
+    FedConfig,
+    cohort_updates,
+    make_client_step,
+    tree_add,
+    tree_sub,
+)
 from deepreduce_tpu.metrics import WireStats, combine
 from deepreduce_tpu.telemetry import spans
 from deepreduce_tpu.wrappers import TensorCodec
-
-
-@dataclasses.dataclass(frozen=True)
-class FedConfig:
-    """Round geometry (paper §6.2: 56 clients sampled from 57 VMs;
-    Table 5: 10 clients, 800 rounds)."""
-
-    num_clients: int
-    clients_per_round: int
-    local_steps: int = 1
-    server_lr: float = 1.0
 
 
 @jax.tree_util.register_pytree_node_class
@@ -94,42 +93,26 @@ class FedAvg:
         self.cfg_s2c = cfg_s2c if cfg_s2c is not None else cfg_c2s
         self.fed = fed
         self.client_opt = client_optimizer
-        self._codecs: Dict[str, Dict[Any, TensorCodec]] = {}
+        # per-direction path-keyed codec banks (one TensorCodec per leaf
+        # PATH, not per flat index — see fedsim.codec_tree)
+        self._tree_codecs: Dict[str, TreeCodec] = {
+            "s2c": TreeCodec("s2c", self.cfg_s2c),
+            "c2s": TreeCodec("c2s", self.cfg_c2s),
+        }
 
     # ------------------------------------------------------------------ #
 
     def _codec(self, direction: str, path: str, shape) -> TensorCodec:
-        cfg = self.cfg_s2c if direction == "s2c" else self.cfg_c2s
-        per_dir = self._codecs.setdefault(direction, {})
-        if path not in per_dir:
-            per_dir[path] = TensorCodec(tuple(shape), cfg, name=f"{direction}/{path}")
-        return per_dir[path]
+        """One direction's codec for the leaf at treedef `path` (e.g.
+        `"['w']"` from `jax.tree_util.keystr`)."""
+        return self._tree_codecs[direction].codec(path, shape)
 
     def _compress_tree(
         self, direction: str, tree: Any, residual: Optional[Any], step, key
     ) -> Tuple[Any, Optional[Any], WireStats]:
         """Encode+decode each leaf through its codec: returns (what the
         receiver reconstructs, updated residual, wire bits)."""
-        leaves, treedef = jax.tree_util.tree_flatten(tree)
-        res_leaves = (
-            jax.tree_util.tree_leaves(residual) if residual is not None else [None] * len(leaves)
-        )
-        out, new_res, stats = [], [], []
-        for i, (leaf, r) in enumerate(zip(leaves, res_leaves)):
-            codec = self._codec(direction, str(i), leaf.shape)
-            flat = leaf.reshape(-1)
-            comp = flat + r.reshape(-1) if r is not None else flat
-            k = jax.random.fold_in(key, i)
-            payload = codec.encode(comp.reshape(leaf.shape), step=step, key=k)
-            dec = codec.decode(payload, step=step).reshape(leaf.shape)
-            out.append(dec)
-            new_res.append((comp.reshape(leaf.shape) - dec) if r is not None else None)
-            stats.append(codec.wire_stats(payload))
-        wire = combine({str(i): s for i, s in enumerate(stats)})
-        new_residual = (
-            jax.tree_util.tree_unflatten(treedef, new_res) if residual is not None else None
-        )
-        return jax.tree_util.tree_unflatten(treedef, out), new_residual, wire
+        return self._tree_codecs[direction].compress_tree(tree, residual, step, key)
 
     # ------------------------------------------------------------------ #
 
@@ -179,119 +162,81 @@ class FedAvg:
         key: jax.Array,
         *,
         participation: Optional[jax.Array] = None,
+        impl: str = "scan",
     ) -> Tuple[FedAvgState, Dict[str, Any]]:
         """One round. `ids` from `sample_clients`; `client_batches` leaves
         are [clients_per_round, local_steps, ...] for exactly those ids.
 
         `participation` (bool[C] over the SAMPLED clients, or None) models
         a sampled client failing to return its C2S update: a False
-        client's decoded update and wire bits are scaled to zero, the
-        server mean renormalizes by the live count, and the client's C2S
-        residual is left untouched (it never compressed, so there is no
-        new error to feed back — its pending mass waits for the next time
-        it is sampled). The S2C broadcast stays global: `w_ref` models
-        what every client *can* reconstruct from the broadcast stream.
-        With participation=None the traced round is unchanged."""
+        client's decoded update and wire bits are zeroed, the server mean
+        renormalizes by the live count, and the client's C2S residual is
+        left untouched (it never compressed, so there is no new error to
+        feed back — its pending mass waits for the next time it is
+        sampled). The S2C broadcast stays global: `w_ref` models what
+        every client *can* reconstruct from the broadcast stream. With
+        participation=None the traced round is unchanged.
+
+        `impl` selects the cohort execution: "scan" (the reference scalar
+        path, compiled size independent of C) or "vmap" (all clients in
+        one batched block — what `fedsim.FedSim` scales out)."""
         C = self.fed.clients_per_round
-        has_part = participation is not None
-        part = participation.astype(jnp.float32) if has_part else None
         key_s2c, key_c2s = jax.random.split(key)
 
         # --- S2C: broadcast the compressed model delta -------------------
         # delta is taken against the receiver-side state w_ref, so the
         # loop is self-correcting: undelivered mass reappears in the next
         # round's delta (no explicit residual — see module docstring)
-        delta = jax.tree_util.tree_map(lambda w, r: w - r, state.params, state.w_ref)
+        delta = tree_sub(state.params, state.w_ref)
         with spans.span("fedavg/s2c"):
             dec_delta, _, wire_s2c = self._compress_tree(
                 "s2c", delta, None, state.round, key_s2c
             )
-        w_ref = jax.tree_util.tree_map(jnp.add, state.w_ref, dec_delta)
+        w_ref = tree_add(state.w_ref, dec_delta)
 
         # --- local training + C2S on each sampled client -----------------
-        # ONE lax.scan over the stacked client axis: the compiled program
-        # size is independent of C (the paper's 56-client config would
-        # otherwise build 56 copies of local-train + codec). Residuals for
-        # the sampled ids are gathered up front and scattered back after —
-        # ids are drawn without replacement, so the batched scatter is
-        # collision-free.
+        # Residuals for the sampled ids are gathered up front and scattered
+        # back after — ids are drawn without replacement, so the batched
+        # scatter is collision-free.
         c2s_res = state.c2s_residuals
         use_res = c2s_res is not None
         res_stack = (
             jax.tree_util.tree_map(lambda r: r[ids], c2s_res) if use_res else None
         )
-        upd_sum0 = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-        wire0 = WireStats(
-            index_bits=jnp.zeros((), jnp.float32),
-            value_bits=jnp.zeros((), jnp.float32),
-            dense_bits=jnp.zeros((), jnp.float32),
+        client_step = make_client_step(
+            self._tree_codecs["c2s"], self._local_train, w_ref, state.round, key_c2s
         )
-
-        def client_body(carry, xs):
-            upd_sum, wire_acc = carry
-            c, batch_c = xs[0], xs[1]
-            rest = xs[2:]
-            res_c = rest[0] if use_res else None
-            m = rest[-1] if has_part else None
-            with spans.span("fedavg/local_train"):
-                p_end = self._local_train(
-                    w_ref, batch_c, jax.random.fold_in(key_c2s, 2 * c)
-                )
-            update = jax.tree_util.tree_map(lambda a, b: a - b, p_end, w_ref)
-            with spans.span("fedavg/c2s"):
-                dec_upd, new_res_c, wire_c = self._compress_tree(
-                    "c2s", update, res_c, state.round,
-                    jax.random.fold_in(key_c2s, 2 * c + 1),
-                )
-            if has_part:
-                # a non-participating client returns nothing: zero its
-                # decoded update and wire bits, and keep its residual as it
-                # was (no compression happened, no new error to feed back)
-                dec_upd = jax.tree_util.tree_map(lambda u: u * m, dec_upd)
-                if use_res:
-                    new_res_c = jax.tree_util.tree_map(
-                        lambda new, old: jnp.where(m > 0, new, old),
-                        new_res_c,
-                        res_c,
-                    )
-                wire_c = WireStats(
-                    index_bits=wire_c.index_bits * m,
-                    value_bits=wire_c.value_bits * m,
-                    dense_bits=wire_c.dense_bits * m,
-                )
-            upd_sum = jax.tree_util.tree_map(jnp.add, upd_sum, dec_upd)
-            wire_acc = WireStats(
-                index_bits=wire_acc.index_bits + wire_c.index_bits,
-                value_bits=wire_acc.value_bits + wire_c.value_bits,
-                dense_bits=wire_acc.dense_bits + wire_c.dense_bits,
-            )
-            return (upd_sum, wire_acc), (new_res_c if use_res else 0)
-
-        cs = jnp.arange(C, dtype=jnp.uint32)
-        xs = (cs, client_batches)
-        if use_res:
-            xs = xs + (res_stack,)
-        if has_part:
-            xs = xs + (part,)
+        positions = jnp.arange(C, dtype=jnp.uint32)
         with spans.span("fedavg/clients"):
-            (upd_sum, wire_c2s), new_res_stack = jax.lax.scan(
-                client_body, (upd_sum0, wire0), xs
+            upd_sum, new_res_stack, wire4, live = cohort_updates(
+                client_step,
+                client_batches,
+                res_stack,
+                positions,
+                update_template=state.params,
+                participation=participation,
+                impl=impl,
             )
         if use_res:
             c2s_res = jax.tree_util.tree_map(
                 lambda buf, nr: buf.at[ids].set(nr), c2s_res, new_res_stack
             )
-        wires = [wire_s2c, wire_c2s]
+        wire_c2s = WireStats(
+            index_bits=wire4[0],
+            value_bits=wire4[1],
+            dense_bits=wire4[2],
+            saturated=wire4[3],
+        )
 
-        if has_part:
-            live = jnp.maximum(jnp.sum(part), 1.0)
-            mean_upd = jax.tree_util.tree_map(lambda s: s / live, upd_sum)
+        if participation is not None:
+            live_count = jnp.maximum(jnp.sum(live), 1.0)
+            mean_upd = jax.tree_util.tree_map(lambda s: s / live_count, upd_sum)
         else:
             mean_upd = jax.tree_util.tree_map(lambda s: s / C, upd_sum)
         new_params = jax.tree_util.tree_map(
             lambda w, u: w + self.fed.server_lr * u, state.params, mean_upd
         )
-        wire = combine({str(i): s for i, s in enumerate(wires)})
+        wire = combine({"s2c": wire_s2c, "c2s": wire_c2s})
         new_state = FedAvgState(
             params=new_params,
             w_ref=w_ref,

@@ -1,0 +1,25 @@
+"""Population-scale federated simulation (see sim.py's module docstring).
+
+Import surface:
+
+- `FedConfig`, `cohort_updates`, `make_client_step` (round.py) — the round
+  bodies `fedavg.FedAvg` delegates to.
+- `TreeCodec` (codec_tree.py) — path-keyed per-leaf `TensorCodec` bank.
+- `FedSim`, `FedSimState`, `synthetic_linear_problem` (sim.py) — the
+  client-sharded population driver.
+"""
+
+from deepreduce_tpu.fedsim.codec_tree import TreeCodec, TreeSpec
+from deepreduce_tpu.fedsim.round import FedConfig, cohort_updates, make_client_step
+from deepreduce_tpu.fedsim.sim import FedSim, FedSimState, synthetic_linear_problem
+
+__all__ = [
+    "FedConfig",
+    "FedSim",
+    "FedSimState",
+    "TreeCodec",
+    "TreeSpec",
+    "cohort_updates",
+    "make_client_step",
+    "synthetic_linear_problem",
+]

@@ -1,0 +1,155 @@
+"""Path-keyed pytree codec bank — one `TensorCodec` per (direction, leaf path).
+
+`FedAvg` originally cached codecs by *flat leaf index* (`str(i)`), so two
+pytrees with the same leaf shapes in swapped order would silently reuse each
+other's codec names in telemetry, and two different-shape leaves landing on
+the same index across calls would collide outright. `TreeCodec` keys the
+cache by the treedef path (`jax.tree_util.keystr`), which is stable under
+leaf reordering and self-describing in span/wire labels
+(`c2s/['w']`, not `c2s/0`).
+
+The encode/decode split (vs the fused `compress_tree`) exists for the
+federated uplink: the fedsim round packs the encoded payloads into a flat
+byte buffer (`comm.PayloadLayout`) so the resilience layer can checksum and
+chaos-perturb the *wire image*, then decodes on the far side. PRNG keys are
+still folded by flat leaf *position* (not path) so numerics are unchanged
+from the pre-refactor `FedAvg._compress_tree`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.metrics import WireStats, combine
+from deepreduce_tpu.wrappers import TensorCodec
+
+
+@dataclasses.dataclass(frozen=True)
+class TreeSpec:
+    """Host-side skeleton of one flattened tree: enough to decode a payload
+    list back into the original structure."""
+
+    paths: Tuple[str, ...]
+    shapes: Tuple[Tuple[int, ...], ...]
+    treedef: Any
+
+    def unflatten(self, leaves: List[Any]) -> Any:
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+class TreeCodec:
+    """A directory of per-leaf `TensorCodec`s for one transfer direction."""
+
+    def __init__(self, direction: str, cfg: DeepReduceConfig):
+        self.direction = direction
+        self.cfg = cfg
+        self._codecs: Dict[str, TensorCodec] = {}
+
+    def codec(self, path: str, shape) -> TensorCodec:
+        shape = tuple(int(s) for s in shape)
+        codec = self._codecs.get(path)
+        if codec is None:
+            codec = TensorCodec(shape, self.cfg, name=f"{self.direction}/{path}")
+            self._codecs[path] = codec
+        elif codec.shape != shape:
+            raise ValueError(
+                f"leaf path {path!r} previously had shape {codec.shape}, now "
+                f"{shape} — the codec cache is keyed by treedef path, which "
+                "must map to one static shape"
+            )
+        return codec
+
+    def spec(self, tree: Any) -> TreeSpec:
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        return TreeSpec(
+            paths=tuple(jax.tree_util.keystr(p) for p, _ in leaves_with_path),
+            shapes=tuple(tuple(leaf.shape) for _, leaf in leaves_with_path),
+            treedef=treedef,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def encode_tree(
+        self, tree: Any, residual: Optional[Any], step, key
+    ) -> Tuple[List[Any], List[jax.Array], TreeSpec]:
+        """Compress `tree + residual` leaf-by-leaf. Returns the payload list
+        (flatten order), the pre-compression leaves `leaf + residual` (what
+        the sender must subtract the decode from to get its new residual),
+        and the host-side `TreeSpec`."""
+        spec = self.spec(tree)
+        leaves = jax.tree_util.tree_leaves(tree)
+        res_leaves = (
+            jax.tree_util.tree_leaves(residual)
+            if residual is not None
+            else [None] * len(leaves)
+        )
+        payloads, comps = [], []
+        for i, (path, leaf, r) in enumerate(zip(spec.paths, leaves, res_leaves)):
+            codec = self.codec(path, leaf.shape)
+            comp = leaf + r if r is not None else leaf
+            k = jax.random.fold_in(key, i)
+            payloads.append(codec.encode(comp, step=step, key=k))
+            comps.append(comp)
+        return payloads, comps, spec
+
+    def decode_tree(self, payloads: List[Any], spec: TreeSpec, step) -> Any:
+        out = [
+            self.codec(path, shape).decode(p, step=step).reshape(shape)
+            for path, shape, p in zip(spec.paths, spec.shapes, payloads)
+        ]
+        return spec.unflatten(out)
+
+    def wire_tree(self, payloads: List[Any], spec: TreeSpec) -> WireStats:
+        return combine(
+            {
+                path: self.codec(path, shape).wire_stats(p)
+                for path, shape, p in zip(spec.paths, spec.shapes, payloads)
+            }
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def compress_tree(
+        self, tree: Any, residual: Optional[Any], step, key
+    ) -> Tuple[Any, Optional[Any], WireStats]:
+        """Fused encode+decode (the in-place simulation path `FedAvg` uses):
+        returns (receiver's reconstruction, updated residual, wire bits)."""
+        payloads, comps, spec = self.encode_tree(tree, residual, step, key)
+        dec_leaves = [
+            self.codec(path, shape).decode(p, step=step).reshape(shape)
+            for path, shape, p in zip(spec.paths, spec.shapes, payloads)
+        ]
+        wire = self.wire_tree(payloads, spec)
+        dec_tree = spec.unflatten(dec_leaves)
+        new_residual = (
+            spec.unflatten([c - d for c, d in zip(comps, dec_leaves)])
+            if residual is not None
+            else None
+        )
+        return dec_tree, new_residual, wire
+
+    def payload_sds(self, tree_sds: Any, step=0) -> Tuple[List[Any], TreeSpec]:
+        """Abstract payload structure (ShapeDtypeStructs) for a tree of that
+        shape — what `comm.PayloadLayout` needs to build its static layout."""
+        spec = self.spec(tree_sds)
+
+        def _enc(leaves):
+            key = jax.random.PRNGKey(0)
+            payloads = []
+            for i, (path, leaf) in enumerate(zip(spec.paths, leaves)):
+                codec = self.codec(path, leaf.shape)
+                payloads.append(
+                    codec.encode(leaf, step=step, key=jax.random.fold_in(key, i))
+                )
+            return payloads
+
+        leaves_sds = [
+            jax.ShapeDtypeStruct(s.shape, s.dtype)
+            for s in jax.tree_util.tree_leaves(tree_sds)
+        ]
+        return jax.eval_shape(_enc, leaves_sds), spec

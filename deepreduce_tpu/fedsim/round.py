@@ -1,0 +1,315 @@
+"""Federated round bodies: one client's uplink, and a cohort's worth of them.
+
+This module is the round *body* shared by the two federated drivers:
+
+- `fedavg.FedAvg.run_round` — the paper-faithful scalar harness (ONE
+  `lax.scan` over the sampled cohort, `impl="scan"`), kept as the proven
+  reference semantics.
+- `fedsim.sim.FedSim` — the population-scale driver (`impl="vmap"`,
+  optionally chunked), which runs thousands of simulated clients per device
+  step and shards cohorts across a mesh axis.
+
+Both execute the *same* `client_step` closure per client: local training,
+update compression through the real `TensorCodec` stack with per-client
+error feedback, and (when engaged) the resilience uplink stage — payload
+pack → chaos perturbation → checksum verify — with graceful
+zero-contribution degradation. Equivalence between the two `impl`s is
+pinned by tests/test_fedsim.py.
+
+Degradation semantics (mirrors train.py's worker-dropout story):
+
+- a *non-participating* client (churn: `FaultPlan` / drop_rate) never
+  trained: its update, wire bits, and residual write are all suppressed —
+  its pending EF mass waits for the next time it is sampled.
+- a client whose payload *fails the checksum* did train and transmit: its
+  wire bits count, its residual advances (client-side EF already ran — the
+  lost mass is genuinely lost, which is the graceful-degradation price),
+  but its decoded update is excluded from the server mean via a
+  `jnp.where` SELECT (never a multiply: corrupt payloads can decode to
+  Inf/NaN, and `NaN * 0 == NaN`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepreduce_tpu.fedsim.codec_tree import TreeCodec
+
+# wire scalars threaded through scan/vmap as a plain tuple: WireStats'
+# host-numpy ici_bits default must not be vmapped/scanned (see metrics.py)
+WIRE_FIELDS = ("index_bits", "value_bits", "dense_bits", "saturated")
+
+
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    """Round geometry (paper §6.2: 56 clients sampled from 57 VMs;
+    Table 5: 10 clients, 800 rounds)."""
+
+    num_clients: int
+    clients_per_round: int
+    local_steps: int = 1
+    server_lr: float = 1.0
+
+    def __post_init__(self):
+        if self.num_clients <= 0:
+            raise ValueError(f"num_clients must be positive, got {self.num_clients}")
+        if self.clients_per_round <= 0:
+            raise ValueError(
+                f"clients_per_round must be positive, got {self.clients_per_round}"
+            )
+        if self.clients_per_round > self.num_clients:
+            raise ValueError(
+                f"clients_per_round={self.clients_per_round} exceeds the "
+                f"population num_clients={self.num_clients} — sampling is "
+                "without replacement (Algorithm 2), so a round cannot draw "
+                "more clients than exist"
+            )
+        if self.local_steps <= 0:
+            raise ValueError(f"local_steps must be positive, got {self.local_steps}")
+        if self.server_lr <= 0:
+            raise ValueError(f"server_lr must be positive, got {self.server_lr}")
+
+
+def tree_sub(a: Any, b: Any) -> Any:
+    return jax.tree_util.tree_map(lambda x, y: x - y, a, b)
+
+
+def tree_add(a: Any, b: Any) -> Any:
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def make_client_step(
+    tree_codec: TreeCodec,
+    local_train: Callable[[Any, Any, jax.Array], Any],
+    w_ref: Any,
+    step: jax.Array,
+    key_c2s: jax.Array,
+    *,
+    layout=None,
+    chaos=None,
+) -> Callable:
+    """Build the per-client body. `pos` is the client's *cohort position*
+    (uint32 scalar): PRNG keys fold `2*pos` (local train) and `2*pos + 1`
+    (compression), exactly the pre-refactor `FedAvg` derivation, so the
+    scalar path's numerics are unchanged.
+
+    `layout` (a `comm.PayloadLayout` over this model's payload pytree)
+    engages the wire-image stage: payloads are packed to a flat byte
+    buffer, optionally chaos-perturbed, checksum-verified, and decoded
+    from the buffer — the same pack/verify/unpack path the data-parallel
+    exchange uses. Without it, the sender-side reconstruction doubles as
+    the receiver's (pack/unpack is a bitcast round-trip, so this is exact,
+    not an approximation).
+
+    Returns `(dec_update_tree, new_residual_tree_or_None, wire4, ok)` where
+    `wire4` is `(index, value, dense, saturated)` bits as f32 scalars and
+    `ok` is the f32 checksum gate (1.0 when no layout)."""
+
+    def client_step(batch_c: Any, res_c: Optional[Any], pos: jax.Array):
+        p_end = local_train(w_ref, batch_c, jax.random.fold_in(key_c2s, 2 * pos))
+        update = tree_sub(p_end, w_ref)
+        payloads, comps, spec = tree_codec.encode_tree(
+            update, res_c, step, jax.random.fold_in(key_c2s, 2 * pos + 1)
+        )
+        dec_leaves = [
+            tree_codec.codec(path, shape).decode(p, step=step).reshape(shape)
+            for path, shape, p in zip(spec.paths, spec.shapes, payloads)
+        ]
+        if layout is not None:
+            buf = layout.pack(payloads)
+            if chaos is not None:
+                buf = chaos.perturb(buf, step=step, worker=pos)
+                # the wire image changed: the receiver decodes what arrived
+                recv = layout.unpack(buf)
+                dec_recv = tree_codec.decode_tree(recv, spec, step)
+            else:
+                dec_recv = spec.unflatten(dec_leaves)
+            ok = layout.verify(buf)
+        else:
+            dec_recv = spec.unflatten(dec_leaves)
+            ok = jnp.ones((), jnp.float32)
+        # sender-side EF: the client's residual is against what IT encoded
+        # (it cannot observe wire corruption), i.e. the clean decode
+        new_res = (
+            spec.unflatten([c - d for c, d in zip(comps, dec_leaves)])
+            if res_c is not None
+            else None
+        )
+        wire = tree_codec.wire_tree(payloads, spec)
+        wire4 = tuple(
+            jnp.asarray(getattr(wire, f), jnp.float32).reshape(()) for f in WIRE_FIELDS
+        )
+        return dec_recv, new_res, wire4, ok
+
+    return client_step
+
+
+def _mask_tree(tree: Any, gate: jax.Array) -> Any:
+    """Zero a client's contribution via SELECT (gate is a f32 scalar or a
+    [C] vector broadcast against [C, ...] leaves)."""
+
+    def _one(u):
+        g = gate.reshape(gate.shape + (1,) * (u.ndim - gate.ndim))
+        return jnp.where(g > 0, u, jnp.zeros_like(u))
+
+    return jax.tree_util.tree_map(_one, tree)
+
+
+def cohort_updates(
+    client_step: Callable,
+    client_batches: Any,
+    res_stack: Optional[Any],
+    positions: jax.Array,
+    *,
+    update_template: Any,
+    participation: Optional[jax.Array] = None,
+    checksum: bool = False,
+    impl: str = "scan",
+    chunk: int = 0,
+) -> Tuple[Any, Optional[Any], Tuple[jax.Array, ...], jax.Array]:
+    """Run `client_step` over a cohort and aggregate. `update_template` is
+    any tree with the model's structure/shapes/dtypes (e.g. `w_ref`) — it
+    seeds the scan accumulators.
+
+    `client_batches` leaves are [C, local_steps, ...]; `res_stack` (or None)
+    leaves are [C, ...]; `positions` is uint32[C] cohort positions (global
+    across shards in the fedsim case). `participation` is an optional
+    f32/bool[C] churn mask. `checksum` declares (statically) that
+    `client_step`'s `ok` output is a real gate — when False and no
+    participation mask is given, no masking is staged at all, which keeps
+    the plain round's jaxpr identical to the pre-resilience program.
+
+    impl="scan" — ONE `lax.scan` over the cohort (compiled size independent
+    of C; the `FedAvg` reference path). impl="vmap" — all clients batched
+    in one vmapped block; `chunk` > 0 additionally scans over blocks of
+    `chunk` vmapped clients to bound peak memory ("vmapped client
+    batches"), requiring chunk | C.
+
+    Returns (upd_sum_tree, new_res_stack_or_None, wire4_sums, live_f32[C])
+    where `live[c] = participation[c] * ok[c]` is the effective
+    contribution gate (all-ones when nothing is engaged)."""
+    (C,) = positions.shape
+    use_res = res_stack is not None
+    has_part = participation is not None
+    has_live = has_part or checksum
+    part = jnp.asarray(participation, jnp.float32) if has_part else None
+
+    def one_client(batch_c, res_c, pos, m):
+        dec_upd, new_res_c, wire4, ok = client_step(batch_c, res_c, pos)
+        live_c = ok * m if has_part else ok
+        if has_live:
+            dec_upd = _mask_tree(dec_upd, live_c)
+        if has_part:
+            if use_res:
+                # churned client never compressed: keep its old residual
+                new_res_c = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(m > 0, new, old), new_res_c, res_c
+                )
+            # churned client transmitted nothing; a checksum-failed client
+            # DID transmit, so `ok` does not gate the wire accounting
+            wire4 = tuple(w * m for w in wire4)
+        return dec_upd, new_res_c, wire4, live_c
+
+    upd_sum0 = jax.tree_util.tree_map(jnp.zeros_like, update_template)
+    wire0 = tuple(jnp.zeros((), jnp.float32) for _ in WIRE_FIELDS)
+
+    if impl == "scan":
+
+        def body(carry, xs):
+            upd_sum, wire_acc = carry
+            pos, batch_c = xs[0], xs[1]
+            rest = xs[2:]
+            res_c = rest[0] if use_res else None
+            m = rest[-1] if has_part else None
+            dec_upd, new_res_c, wire4, live_c = one_client(batch_c, res_c, pos, m)
+            upd_sum = tree_add(upd_sum, dec_upd)
+            wire_acc = tuple(a + w for a, w in zip(wire_acc, wire4))
+            return (upd_sum, wire_acc), (new_res_c if use_res else 0, live_c)
+
+        xs = (positions, client_batches)
+        if use_res:
+            xs = xs + (res_stack,)
+        if has_part:
+            xs = xs + (part,)
+        (upd_sum, wire_acc), (new_res_stack, live) = jax.lax.scan(
+            body, (upd_sum0, wire0), xs
+        )
+        return upd_sum, (new_res_stack if use_res else None), wire_acc, live
+
+    if impl != "vmap":
+        raise ValueError(f"impl must be 'scan' or 'vmap', got {impl!r}")
+
+    def block(batches_b, res_b, pos_b, part_b):
+        """One vmapped block of clients -> (upd_sum, new_res, wire4, live)."""
+        if use_res:
+            dec, nres, wire4, ok = jax.vmap(
+                lambda b, r, p: client_step(b, r, p)
+            )(batches_b, res_b, pos_b)
+        else:
+            dec, nres, wire4, ok = jax.vmap(
+                lambda b, p: client_step(b, None, p)
+            )(batches_b, pos_b)
+        live_b = ok * part_b if has_part else ok
+        if has_live:
+            dec = _mask_tree(dec, live_b)
+        if has_part:
+            if use_res:
+                nres = jax.tree_util.tree_map(
+                    lambda new, old: _mask_where(part_b, new, old), nres, res_b
+                )
+            wire4 = tuple(w * part_b for w in wire4)
+        upd_b = jax.tree_util.tree_map(lambda u: jnp.sum(u, axis=0), dec)
+        wire_b = tuple(jnp.sum(w) for w in wire4)
+        return upd_b, nres, wire_b, live_b
+
+    if chunk and 0 < chunk < C:
+        if C % chunk:
+            raise ValueError(f"chunk={chunk} must divide the cohort size {C}")
+        n_blocks = C // chunk
+
+        def reshape_blocks(tree):
+            return jax.tree_util.tree_map(
+                lambda x: x.reshape((n_blocks, chunk) + x.shape[1:]), tree
+            )
+
+        xs = (
+            reshape_blocks(positions),
+            reshape_blocks(client_batches),
+            reshape_blocks(res_stack) if use_res else None,
+            reshape_blocks(part) if has_part else None,
+        )
+
+        def body(carry, xs_b):
+            upd_sum, wire_acc = carry
+            pos_b, batches_b, res_b, part_b = xs_b
+            upd_b, nres_b, wire_b, live_b = block(batches_b, res_b, pos_b, part_b)
+            upd_sum = tree_add(upd_sum, upd_b)
+            wire_acc = tuple(a + w for a, w in zip(wire_acc, wire_b))
+            return (upd_sum, wire_acc), (nres_b if use_res else 0, live_b)
+
+        (upd_sum, wire_acc), (nres_blocks, live_blocks) = jax.lax.scan(
+            body, (upd_sum0, wire0), xs
+        )
+        new_res_stack = (
+            jax.tree_util.tree_map(
+                lambda x: x.reshape((C,) + x.shape[2:]), nres_blocks
+            )
+            if use_res
+            else None
+        )
+        live = live_blocks.reshape((C,))
+        return upd_sum, new_res_stack, wire_acc, live
+
+    upd_sum, new_res_stack, wire_acc, live = block(
+        client_batches, res_stack, positions, part
+    )
+    return upd_sum, new_res_stack, wire_acc, live
+
+
+def _mask_where(gate_vec: jax.Array, new: jax.Array, old: jax.Array) -> jax.Array:
+    g = gate_vec.reshape(gate_vec.shape + (1,) * (new.ndim - gate_vec.ndim))
+    return jnp.where(g > 0, new, old)

@@ -1,0 +1,417 @@
+"""Population-scale federated simulation: client-sharded cohorts in ONE
+jitted round step.
+
+`fedavg.FedAvg` is the paper-faithful harness — tens of clients, one
+`lax.scan`. This driver is the ROADMAP's "million-client federated serving
+simulation": the population's per-client error-feedback state lives in a
+device-sharded residual *bank* (`[num_clients, ...]` leaves, `P(axis)` on
+dim 0) instead of a Python-side dict, and each round
+
+1. every worker samples its stratum's share of the cohort *inside* the
+   jitted step (`jax.random.choice` without replacement over the worker's
+   contiguous `num_clients / W` clients — gather and scatter against the
+   bank stay purely local, no cross-worker addressing),
+2. synthesizes the sampled clients' batches from their global client ids
+   (`data_fn`, traced under vmap — no [population, ...] dataset ever
+   materializes),
+3. runs the shared `fedsim.round.client_step` body — local SGD, real
+   `TensorCodec` compression with per-client EF, and (when engaged) the
+   pack → chaos → checksum uplink stage — over its cohort shard as vmapped
+   client batches (optionally chunked to bound peak memory),
+4. contributes to exactly ONE `lax.psum` of the tuple
+   (update sum, wire bits, live count, checksum failures) — the whole
+   cross-worker traffic of a round, pinned by the `fedsim:round` audit
+   spec — and applies the live-count renormalized server update
+   replicated.
+
+Churn (`FaultPlan` / drop_rate) is drawn over *global cohort positions*
+from the shared round key, so every worker agrees on who is live; a
+worker's slice of that mask gates its local clients. Rounds are
+checkpointable via `checkpoint.py` (the state is one pytree: params, w_ref,
+residual bank, round counter, telemetry accumulators).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepreduce_tpu.comm import PayloadLayout
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.fedsim.codec_tree import TreeCodec
+from deepreduce_tpu.fedsim.round import (
+    FedConfig,
+    WIRE_FIELDS,
+    cohort_updates,
+    make_client_step,
+    tree_add,
+    tree_sub,
+)
+from deepreduce_tpu.metrics import WireStats
+from deepreduce_tpu.resilience.chaos import ChaosInjector
+from deepreduce_tpu.resilience.faults import participation_mask
+from deepreduce_tpu.telemetry import spans
+from deepreduce_tpu.telemetry.device_metrics import MetricAccumulators
+from deepreduce_tpu.utils.compat import shard_map
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class FedSimState:
+    params: Any  # server's true model (replicated)
+    w_ref: Any  # what every client can reconstruct from broadcasts
+    residuals: Optional[Any]  # [num_clients, ...] bank, sharded on dim 0
+    round: jax.Array
+    telemetry: Optional[MetricAccumulators]
+
+    def tree_flatten(self):
+        return (
+            (self.params, self.w_ref, self.residuals, self.round, self.telemetry),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def synthetic_linear_problem(
+    dim: int, batch_size: int, local_steps: int
+) -> Tuple[Any, Callable, Callable]:
+    """A linear-teacher population: every client sees noiseless samples of
+    one shared ground-truth regressor, with batches derived from the
+    client's GLOBAL id (same id -> same data distribution regardless of
+    which worker simulates it). Returns (params0, data_fn, loss_fn)."""
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"] + params["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    def data_fn(client_id, rnd, key):
+        # the teacher is a fixed constant of the problem, not of the round
+        w_true = jax.random.normal(jax.random.PRNGKey(42), (dim,))
+        x = jax.random.normal(key, (local_steps, batch_size, dim))
+        y = x @ w_true
+        return (x, y)
+
+    params0 = {"b": jnp.zeros(()), "w": jnp.zeros((dim,))}
+    return params0, data_fn, loss_fn
+
+
+class FedSim:
+    """Client-sharded federated round driver.
+
+    loss_fn(params, batch) -> scalar; data_fn(global_client_id, round, key)
+    -> one client's [local_steps, ...] batch pytree (traced under vmap).
+    `mesh` (or None for single-device) provides the worker axis the
+    population is sharded over; both `num_clients` and `clients_per_round`
+    must divide its extent.
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        cfg_c2s: DeepReduceConfig,
+        fed: FedConfig,
+        client_optimizer: optax.GradientTransformation,
+        data_fn: Callable,
+        *,
+        cfg_s2c: Optional[DeepReduceConfig] = None,
+        mesh=None,
+        axis: str = "data",
+        client_chunk: int = 0,
+    ):
+        self.loss_fn = loss_fn
+        self.cfg_c2s = cfg_c2s
+        self.cfg_s2c = cfg_s2c if cfg_s2c is not None else cfg_c2s
+        self.fed = fed
+        self.client_opt = client_optimizer
+        self.data_fn = data_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.W = int(mesh.shape[axis]) if mesh is not None else 1
+        if fed.num_clients % self.W:
+            raise ValueError(
+                f"num_clients={fed.num_clients} must divide evenly over the "
+                f"{self.W}-worker '{axis}' axis — each worker owns a "
+                "contiguous stratum of the residual bank"
+            )
+        if fed.clients_per_round % self.W:
+            raise ValueError(
+                f"clients_per_round={fed.clients_per_round} must divide "
+                f"evenly over the {self.W}-worker '{axis}' axis — cohorts "
+                "are sampled stratum-by-stratum"
+            )
+        self.n_local = fed.num_clients // self.W
+        self.c_local = fed.clients_per_round // self.W
+        if self.c_local > self.n_local:
+            raise ValueError(
+                f"per-worker cohort {self.c_local} exceeds the per-worker "
+                f"population {self.n_local} — stratified sampling is without "
+                "replacement"
+            )
+        if client_chunk and self.c_local % client_chunk:
+            raise ValueError(
+                f"client_chunk={client_chunk} must divide the per-worker "
+                f"cohort {self.c_local}"
+            )
+        self.client_chunk = int(client_chunk)
+        self.use_res = cfg_c2s.memory == "residual"
+        # resilience wiring (all None/0 when the subsystem is off: the
+        # plain round's trace carries no resilience ops at all)
+        res_on = bool(getattr(cfg_c2s, "resilience", False))
+        self.drop_rate = cfg_c2s.drop_rate if res_on else 0.0
+        self.fault_plan = cfg_c2s.fault_plan if res_on else None
+        self.checksum = bool(res_on and cfg_c2s.payload_checksum)
+        self.chaos = ChaosInjector.from_config(cfg_c2s)
+        self.tc_c2s = TreeCodec("c2s", cfg_c2s)
+        self.tc_s2c = TreeCodec("s2c", self.cfg_s2c)
+        self._layout: Optional[PayloadLayout] = None
+        self._round: Optional[Callable] = None
+        self._round_times: list = []
+
+    # ------------------------------------------------------------------ #
+
+    def _local_train(self, params: Any, batches: Any, key: jax.Array) -> Any:
+        opt_state = self.client_opt.init(params)
+
+        def one_step(carry, batch):
+            p, o = carry
+            grads = jax.grad(self.loss_fn)(p, batch)
+            updates, o = self.client_opt.update(grads, o, p)
+            return (optax.apply_updates(p, updates), o), None
+
+        (p_end, _), _ = jax.lax.scan(one_step, (params, opt_state), batches)
+        return p_end
+
+    def init(self, params: Any) -> FedSimState:
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        bank = None
+        if self.use_res:
+            N = self.fed.num_clients
+
+            def _zeros():
+                return jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((N,) + p.shape, p.dtype), params
+                )
+
+            if self.mesh is not None:
+                shardings = jax.tree_util.tree_map(
+                    lambda p: NamedSharding(self.mesh, P(self.axis)), params
+                )
+                bank = jax.jit(_zeros, out_shardings=shardings)()
+            else:
+                bank = _zeros()
+        acc = MetricAccumulators.zeros() if self.cfg_c2s.telemetry else None
+        if self.checksum or self.chaos is not None:
+            sds = jax.tree_util.tree_map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
+            )
+            payload_sds, _ = self.tc_c2s.payload_sds(sds)
+            self._layout = PayloadLayout(payload_sds, checksum=self.checksum)
+        self._round = self._build(params)
+        return FedSimState(
+            params=params,
+            w_ref=jax.tree_util.tree_map(jnp.array, params),
+            residuals=bank,
+            round=jnp.zeros((), jnp.int32),
+            telemetry=acc,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def _round_body(self, params, w_ref, bank, acc, rnd, key, widx):
+        fed = self.fed
+        C = fed.clients_per_round
+        C_local, n_local = self.c_local, self.n_local
+        key_s2c, key_c2s, key_sample, key_part, key_data = jax.random.split(key, 5)
+
+        # --- S2C: broadcast the compressed model delta (replicated; the
+        # delta is against the receiver-reconstructable w_ref, the
+        # self-correcting loop fedavg.py documents)
+        delta = tree_sub(params, w_ref)
+        dec_delta, _, wire_s2c = self.tc_s2c.compress_tree(delta, None, rnd, key_s2c)
+        w_ref = tree_add(w_ref, dec_delta)
+
+        # --- stratified cohort sampling inside the step: worker w draws
+        # its C/W cohort slots from its own n_local clients
+        ids_local = jax.random.choice(
+            jax.random.fold_in(key_sample, widx),
+            n_local,
+            (C_local,),
+            replace=False,
+        )
+        gids = widx * n_local + ids_local
+        positions = jnp.uint32(widx * C_local) + jnp.arange(C_local, dtype=jnp.uint32)
+
+        # --- synthesize the sampled clients' local datasets from their
+        # global ids (the population never materializes)
+        batches = jax.vmap(
+            lambda g: self.data_fn(g, rnd, jax.random.fold_in(key_data, g))
+        )(gids)
+        res_stack = (
+            jax.tree_util.tree_map(lambda r: r[ids_local], bank)
+            if self.use_res
+            else None
+        )
+
+        # --- churn over GLOBAL cohort positions from the shared key (every
+        # worker agrees), sliced to this worker's stratum
+        mask = participation_mask(
+            C, rnd, key_part, drop_rate=self.drop_rate, fault_plan=self.fault_plan
+        )
+        part_local = None
+        if mask is not None:
+            part_local = jax.lax.dynamic_slice(
+                mask.astype(jnp.float32), (widx * C_local,), (C_local,)
+            )
+
+        client_step = make_client_step(
+            self.tc_c2s,
+            self._local_train,
+            w_ref,
+            rnd,
+            key_c2s,
+            layout=self._layout,
+            chaos=self.chaos,
+        )
+        upd_sum, new_res_stack, wire4, live = cohort_updates(
+            client_step,
+            batches,
+            res_stack,
+            positions,
+            update_template=params,
+            participation=part_local,
+            checksum=self.checksum,
+            impl="vmap",
+            chunk=self.client_chunk,
+        )
+        if self.use_res:
+            bank = jax.tree_util.tree_map(
+                lambda b, nr: b.at[ids_local].set(nr), bank, new_res_stack
+            )
+        nlive = jnp.sum(live)
+        sent = jnp.sum(part_local) if part_local is not None else jnp.float32(C_local)
+        nfail = sent - nlive  # transmitted but rejected by the checksum
+
+        # --- the round's ONE cross-worker collective: partial update sums,
+        # wire accounting, live/failure counts, all in a single psum tuple
+        if self.W > 1:
+            upd_sum, wire4, nlive, nfail = jax.lax.psum(
+                (upd_sum, wire4, nlive, nfail), self.axis
+            )
+        denom = jnp.maximum(nlive, 1.0)
+        new_params = jax.tree_util.tree_map(
+            lambda w, s: w + fed.server_lr * (s / denom), params, upd_sum
+        )
+
+        # wire accounting: C2S per live uplink + the S2C broadcast once
+        wire = WireStats(
+            index_bits=wire4[0] + wire_s2c.index_bits,
+            value_bits=wire4[1] + wire_s2c.value_bits,
+            dense_bits=wire4[2] + wire_s2c.dense_bits,
+            saturated=wire4[3] + wire_s2c.saturated,
+        )
+        metrics = {
+            "clients": nlive,
+            "checksum_failures": nfail,
+            "uplink_bytes": (wire4[0] + wire4[1]) / 8.0,
+            "downlink_bytes": wire_s2c.total_bits / 8.0,
+            "rel_volume": wire.rel_volume(),
+        }
+        if acc is not None:
+            acc = acc.accumulate(
+                wire,
+                live_workers=nlive,
+                dropped_steps=jnp.asarray(nlive < C, jnp.float32),
+                checksum_failures=nfail,
+            )
+        return new_params, w_ref, bank, acc, rnd + 1, metrics
+
+    def _build(self, params):
+        if self.mesh is None:
+            def fn(params, w_ref, bank, acc, rnd, key):
+                return self._round_body(params, w_ref, bank, acc, rnd, key, 0)
+
+            return jax.jit(fn)
+
+        axis = self.axis
+
+        def spmd(params, w_ref, bank, acc, rnd, key):
+            widx = jax.lax.axis_index(axis)
+            return self._round_body(params, w_ref, bank, acc, rnd, key, widx)
+
+        fn = shard_map(
+            spmd,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(axis), P(), P(), P()),
+            out_specs=(P(), P(), P(axis), P(), P(), P()),
+            check_rep=False,
+        )
+        return jax.jit(fn)
+
+    def sharded_round_fn(self) -> Callable:
+        """The unjitted round callable (shard_map'd when a mesh is set) —
+        what the analysis gate traces on an abstract mesh. Built lazily so
+        trace-only callers never need `init` (which allocates the residual
+        bank on real devices); the checksum/chaos uplink stage still needs
+        `init` first, since the payload layout is derived there."""
+        if self._round is None:
+            if self.checksum or self.chaos is not None:
+                raise RuntimeError(
+                    "call init(params) before sharded_round_fn() when "
+                    "payload_checksum/chaos is engaged — the uplink layout "
+                    "is built from the param shapes in init"
+                )
+            self._round = self._build(None)
+        return self._round.__wrapped__  # the pre-jit callable
+
+    # ------------------------------------------------------------------ #
+
+    def step(self, state: FedSimState, key: jax.Array):
+        """One federated round. Returns (new_state, device metrics dict).
+        Host wall time per round is recorded for `summary()`."""
+        t0 = time.perf_counter()
+        with spans.span("fedsim/round"):
+            params, w_ref, bank, acc, rnd, metrics = self._round(
+                state.params, state.w_ref, state.residuals, state.telemetry,
+                state.round, key,
+            )
+        jax.block_until_ready(params)
+        self._round_times.append(time.perf_counter() - t0)
+        new_state = FedSimState(
+            params=params, w_ref=w_ref, residuals=bank, round=rnd, telemetry=acc
+        )
+        return new_state, metrics
+
+    def summary(self, state: FedSimState) -> Dict[str, float]:
+        """Host-side round-rate report: clients/sec and uplink volume, from
+        the telemetry accumulators plus the recorded round wall times. The
+        first recorded round is dropped when possible (it pays compile)."""
+        out: Dict[str, float] = {
+            "clients_per_round": float(self.fed.clients_per_round),
+            "num_clients": float(self.fed.num_clients),
+            "rounds": float(len(self._round_times)),
+        }
+        times = self._round_times
+        if len(times) > 1:
+            times = times[1:]
+        if times:
+            per_round = sum(times) / len(times)
+            out["round_time_s"] = per_round
+            out["clients_per_sec"] = self.fed.clients_per_round / per_round
+        if state.telemetry is not None:
+            tele = state.telemetry.summary()
+            steps = max(tele["steps"], 1.0)
+            out.update(tele)
+            # uplink: scarce-link bits net of the S2C broadcast is not
+            # separable from the accumulators — report the per-round total
+            out["uplink_bytes_per_round"] = tele["cumulative_total_bits"] / 8.0 / steps
+        return out

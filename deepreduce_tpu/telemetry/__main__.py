@@ -132,7 +132,41 @@ def _run_report(run: pathlib.Path) -> Dict[str, Any]:
     telem = summ.get("telemetry")
     if isinstance(telem, dict):
         report["telemetry"] = telem
+    fedsim = _fedsim_report(hist)
+    if fedsim is not None:
+        report["fedsim"] = fedsim
     return report
+
+
+def _fedsim_report(hist: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Federated-round rates when the run logged fedsim metrics (`clients` +
+    `uplink_bytes` per round, as the fedsim CLI / bench drivers write).
+    clients/sec pairs each round's live-client count with the wall interval
+    to the previous record, first (compile-bearing) interval dropped like
+    `_step_times`."""
+    clients = _series(hist, "clients")
+    uplink = _series(hist, "uplink_bytes")
+    if not clients or not uplink:
+        return None
+    rates = []
+    recs = [r for r in hist if isinstance(r.get("ts"), (int, float))]
+    for prev, cur in zip(recs, recs[1:]):
+        dt = cur["ts"] - prev["ts"]
+        c = cur.get("clients")
+        if dt > 0 and isinstance(c, (int, float)):
+            rates.append(float(c) / dt)
+    if len(rates) > 2:
+        rates = rates[1:]
+    out: Dict[str, Any] = {
+        "uplink_bytes_per_round": _dist(uplink),
+        "clients_per_round": _dist(clients),
+    }
+    if rates:
+        out["clients_per_sec"] = _dist(rates)
+    failures = _series(hist, "checksum_failures")
+    if failures:
+        out["checksum_failures_total"] = sum(failures)
+    return out
 
 
 # ---------------------------------------------------------------------- #
@@ -158,6 +192,23 @@ def cmd_summary(args) -> int:
         print(f"  loss: {rep['loss_first']:.4f} -> {rep['loss_last']:.4f}")
     print(f"  rel_volume: {_fmt_dist(rep['rel_volume'])}")
     print(f"  step_time:  {_fmt_dist(rep['step_time_s'], 's')}")
+    if "fedsim" in rep:
+        fed = rep["fedsim"]
+        print("  fedsim:")
+        if "clients_per_sec" in fed:
+            print(f"    clients_per_sec: {_fmt_dist(fed['clients_per_sec'])}")
+        print(
+            "    uplink_bytes_per_round: "
+            f"{_fmt_dist(fed['uplink_bytes_per_round'], 'B')}"
+        )
+        print(
+            "    clients_per_round: "
+            f"{_fmt_dist(fed['clients_per_round'])}"
+        )
+        if "checksum_failures_total" in fed:
+            print(
+                f"    checksum_failures_total: {fed['checksum_failures_total']:.6g}"
+            )
     if "telemetry" in rep:
         print("  device accumulators:")
         for k, v in sorted(rep["telemetry"].items()):

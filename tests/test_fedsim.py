@@ -1,0 +1,413 @@
+"""Federated-simulation subsystem tests (deepreduce_tpu.fedsim): round-body
+equivalence (vmap == scan == chunked), churn/checksum degradation semantics,
+path-keyed codec caching, the client-sharded FedSim driver on the 8-way
+virtual mesh with bitwise checkpoint resume, the fed_* config surface, and
+the uplink cost model."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepreduce_tpu import FedAvg, FedConfig, checkpoint
+from deepreduce_tpu.comm import PayloadLayout
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.fedsim import (
+    FedSim,
+    TreeCodec,
+    cohort_updates,
+    make_client_step,
+    synthetic_linear_problem,
+)
+from deepreduce_tpu.resilience.chaos import ChaosInjector
+
+DIM, BATCH, LOCAL = 32, 4, 2
+
+
+def _cfg(**kw):
+    base = dict(
+        deepreduce="index",
+        index="bloom",
+        bloom_blocked="mod",
+        compress_ratio=0.25,
+        fpr=0.01,
+        memory="residual",
+        min_compress_size=8,
+    )
+    base.update(kw)
+    return DeepReduceConfig(**base)
+
+
+def _problem(num_clients=8, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(DIM,)).astype(np.float32)
+
+    def batches_for(n, round_seed):
+        r = np.random.default_rng(round_seed)
+        xs = r.normal(size=(n, LOCAL, BATCH, DIM)).astype(np.float32)
+        ys = (xs @ w_true).astype(np.float32)
+        return jnp.asarray(xs), jnp.asarray(ys)
+
+    def loss_fn(params, batch_xy):
+        x, y = batch_xy
+        pred = x @ params["w"] + params["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    params = {"b": jnp.zeros(()), "w": jnp.zeros((DIM,))}
+    return w_true, batches_for, loss_fn, params
+
+
+def _local_train(loss_fn, opt):
+    def train(params, batches, key):
+        opt_state = opt.init(params)
+
+        def one(carry, batch):
+            p, o = carry
+            g = jax.grad(loss_fn)(p, batch)
+            u, o = opt.update(g, o, p)
+            return (optax.apply_updates(p, u), o), None
+
+        (p, _), _ = jax.lax.scan(one, (params, opt_state), batches)
+        return p
+
+    return train
+
+
+def _leaves_equal(a, b):
+    return all(
+        bool(jnp.all(x == y))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )
+
+
+def _leaves_close(a, b, rtol=1e-5, atol=1e-6):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------- #
+# FedConfig + fed_* config validation
+# ---------------------------------------------------------------------- #
+
+
+def test_fed_config_rejects_oversampled_cohort():
+    with pytest.raises(ValueError, match="exceeds the"):
+        FedConfig(num_clients=4, clients_per_round=5)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(num_clients=0, clients_per_round=1),
+        dict(num_clients=-3, clients_per_round=1),
+        dict(num_clients=4, clients_per_round=0),
+        dict(num_clients=4, clients_per_round=2, local_steps=0),
+        dict(num_clients=4, clients_per_round=2, server_lr=0.0),
+        dict(num_clients=4, clients_per_round=2, server_lr=-1.0),
+    ],
+)
+def test_fed_config_rejects_degenerate_geometry(kw):
+    with pytest.raises(ValueError):
+        FedConfig(**kw)
+
+
+def test_fed_knobs_require_master_flag():
+    with pytest.raises(ValueError, match="fed=True"):
+        DeepReduceConfig(fed_num_clients=10)
+    with pytest.raises(ValueError, match="fed=True"):
+        DeepReduceConfig(fed_clients_per_round=4)
+
+
+def test_fed_knobs_validated_under_master_flag():
+    with pytest.raises(ValueError):
+        DeepReduceConfig(fed=True, fed_num_clients=0, fed_clients_per_round=2)
+    with pytest.raises(ValueError, match="exceeds"):
+        DeepReduceConfig(fed=True, fed_num_clients=4, fed_clients_per_round=8)
+    with pytest.raises(ValueError, match="divide"):
+        DeepReduceConfig(
+            fed=True, fed_num_clients=64, fed_clients_per_round=10,
+            fed_client_chunk=3,
+        )
+    cfg = DeepReduceConfig(
+        fed=True, fed_num_clients=64, fed_clients_per_round=16,
+        fed_local_steps=3, fed_server_lr=0.5,
+    )
+    fed = cfg.fed_config()
+    assert (fed.num_clients, fed.clients_per_round) == (64, 16)
+    assert (fed.local_steps, fed.server_lr) == (3, 0.5)
+    with pytest.raises(ValueError):
+        DeepReduceConfig().fed_config()  # fed=False has no round geometry
+
+
+# ---------------------------------------------------------------------- #
+# TreeCodec: path-keyed codec cache (the str(i) flat-index bug)
+# ---------------------------------------------------------------------- #
+
+
+def test_tree_codec_keys_by_path_not_flat_index():
+    tc = TreeCodec("c2s", _cfg())
+    t_full = {"a": jnp.ones((64,)), "b": jnp.ones(())}
+    key = jax.random.PRNGKey(0)
+    tc.encode_tree(t_full, None, 0, key)
+    expected_paths = set(tc.spec(t_full).paths)
+    assert set(tc._codecs) == expected_paths  # paths, not "0"/"1"
+
+    # 'b' alone sits at flat index 0 — index keying would hand it the
+    # (64,)-shaped codec built for 'a'; path keying keeps them separate
+    payloads, _, spec = tc.encode_tree({"b": jnp.ones(())}, None, 0, key)
+    dec = tc.decode_tree(payloads, spec, 0)
+    assert dec["b"].shape == ()
+
+    # one path = one static shape, enforced loudly
+    path_a = tc.spec(t_full).paths[0]
+    with pytest.raises(ValueError, match="keyed by treedef path"):
+        tc.codec(path_a, (128,))
+
+
+def test_fedavg_codecs_are_path_keyed():
+    _, _, loss_fn, params = _problem()
+    fa = FedAvg(loss_fn, _cfg(), FedConfig(num_clients=4, clients_per_round=2),
+                optax.sgd(0.05))
+    fa.init(params)
+    tc = fa._tree_codecs["c2s"]
+    tc.compress_tree(params, None, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0))
+    assert set(tc._codecs) == set(tc.spec(params).paths)
+
+
+# ---------------------------------------------------------------------- #
+# round-body equivalence: vmap == scan == chunked
+# ---------------------------------------------------------------------- #
+
+
+def _one_round(impl, participation=None):
+    _, batches_for, loss_fn, params = _problem()
+    fed = FedConfig(num_clients=8, clients_per_round=4, local_steps=LOCAL)
+    fa = FedAvg(loss_fn, _cfg(), fed, optax.sgd(0.05))
+    state = fa.init(params)
+    key = jax.random.PRNGKey(3)
+    ids = fa.sample_clients(state, key)
+    batches = batches_for(len(ids), round_seed=0)
+    run = jax.jit(fa.run_round, static_argnames=("impl",))
+    state, out = run(
+        state, ids, batches, jax.random.fold_in(key, 1),
+        participation=participation, impl=impl,
+    )
+    return state, out
+
+
+def test_run_round_vmap_matches_scan():
+    """The acceptance contract: the population driver's vmapped cohort body
+    is the scalar reference path up to f32 sum reassociation."""
+    s_scan, o_scan = _one_round("scan")
+    s_vmap, o_vmap = _one_round("vmap")
+    _leaves_close(s_scan.params, s_vmap.params)
+    _leaves_close(s_scan.c2s_residuals, s_vmap.c2s_residuals)
+    assert float(o_scan["rel_volume"]) == pytest.approx(
+        float(o_vmap["rel_volume"]), rel=1e-6
+    )
+
+
+def test_run_round_all_alive_mask_is_bitwise_noop():
+    """An all-alive participation mask must not change a single bit: the
+    where-SELECT gating and the live-count denominator both reduce to the
+    mask-free program's values."""
+    s_free, _ = _one_round("scan")
+    s_mask, _ = _one_round("scan", participation=jnp.ones((4,), jnp.float32))
+    assert _leaves_equal(s_free.params, s_mask.params)
+    assert _leaves_equal(s_free.c2s_residuals, s_mask.c2s_residuals)
+
+
+def test_cohort_chunked_matches_flat_vmap():
+    _, batches_for, loss_fn, params = _problem()
+    train = _local_train(loss_fn, optax.sgd(0.05))
+    tc = TreeCodec("c2s", _cfg())
+    C = 8
+    batches = batches_for(C, round_seed=1)
+    res0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros((C,) + p.shape, p.dtype), params
+    )
+    positions = jnp.arange(C, dtype=jnp.uint32)
+    cs = make_client_step(tc, train, params, 0, jax.random.PRNGKey(5))
+    run = functools.partial(
+        cohort_updates, cs, batches, res0, positions, update_template=params,
+        impl="vmap",
+    )
+    upd_f, res_f, wire_f, live_f = run(chunk=0)
+    upd_c, res_c, wire_c, live_c = run(chunk=2)
+    _leaves_close(upd_f, upd_c)
+    _leaves_close(res_f, res_c, rtol=1e-6, atol=0)
+    assert bool(jnp.all(live_f == live_c))
+    for a, b in zip(wire_f, wire_c):
+        assert float(a) == pytest.approx(float(b))
+
+
+# ---------------------------------------------------------------------- #
+# degradation: chaos-corrupted uplinks drop out, nothing else moves
+# ---------------------------------------------------------------------- #
+
+
+def test_chaos_round_equals_clean_round_minus_failed_clients():
+    """A chaos-injected cohort round must equal the clean round with the
+    checksum-failed clients' updates excluded — and the residual bank must
+    advance identically (sender-side EF cannot observe wire corruption)."""
+    cfg = _cfg(
+        resilience=True, payload_checksum=True, chaos_corrupt_rate=0.5,
+    )
+    _, batches_for, loss_fn, params = _problem()
+    train = _local_train(loss_fn, optax.sgd(0.05))
+    C = 8
+    batches = batches_for(C, round_seed=2)
+    res0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros((C,) + p.shape, p.dtype), params
+    )
+    positions = jnp.arange(C, dtype=jnp.uint32)
+    key = jax.random.PRNGKey(7)
+
+    tc = TreeCodec("c2s", cfg)
+    sds = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
+    )
+    payload_sds, _ = tc.payload_sds(sds)
+    layout = PayloadLayout(payload_sds, checksum=True)
+    chaos = ChaosInjector.from_config(cfg)
+    assert chaos is not None
+    cs_chaos = make_client_step(
+        tc, train, params, 0, key, layout=layout, chaos=chaos
+    )
+    upd, res, wire4, live = jax.jit(
+        lambda b, r: cohort_updates(
+            cs_chaos, b, r, positions, update_template=params,
+            checksum=True, impl="vmap",
+        )
+    )(batches, res0)
+    live_np = np.asarray(live)
+    assert 0 < live_np.sum() < C, live_np  # both outcomes present
+
+    # the clean reference: same keys/codecs, no wire stage at all
+    cs_clean = make_client_step(TreeCodec("c2s", _cfg()), train, params, 0, key)
+    dec, nres, _, ok = jax.jit(
+        jax.vmap(lambda b, r, p: cs_clean(b, r, p))
+    )(batches, res0, positions)
+    assert bool(jnp.all(ok == 1.0))
+    expected = jax.tree_util.tree_map(
+        lambda u: jnp.sum(
+            jnp.where(
+                live.reshape((C,) + (1,) * (u.ndim - 1)) > 0, u, 0.0
+            ),
+            axis=0,
+        ),
+        dec,
+    )
+    assert _leaves_equal(upd, expected)
+    assert _leaves_equal(res, nres)  # EF advances for failed clients too
+    # checksum-failed clients still transmitted: wire bits count all C
+    clean_wire = jax.jit(
+        jax.vmap(lambda b, r, p: cs_clean(b, r, p)[2])
+    )(batches, res0, positions)
+    for got, per_client in zip(wire4, clean_wire):
+        assert float(got) == pytest.approx(float(jnp.sum(per_client)))
+
+
+# ---------------------------------------------------------------------- #
+# FedSim: the client-sharded driver on the 8-way virtual mesh
+# ---------------------------------------------------------------------- #
+
+
+def test_fedsim_sharded_rounds_and_bitwise_resume(mesh8, tmp_path):
+    cfg = _cfg(
+        fed=True, fed_num_clients=64, fed_clients_per_round=16,
+        fed_local_steps=LOCAL,
+    )
+    fed = cfg.fed_config()
+    params0, data_fn, loss_fn = synthetic_linear_problem(DIM, BATCH, LOCAL)
+
+    def build():
+        fs = FedSim(
+            loss_fn, cfg, fed, optax.sgd(0.1), data_fn,
+            mesh=mesh8, client_chunk=2,
+        )
+        return fs, fs.init(params0)
+
+    fs, state = build()
+    assert state.residuals["w"].shape == (64, DIM)  # the sharded bank
+    key = jax.random.PRNGKey(0)
+    state, m = fs.step(state, jax.random.fold_in(key, 0))
+    assert float(m["clients"]) == 16.0  # no churn configured
+    assert float(m["checksum_failures"]) == 0.0
+    assert float(m["uplink_bytes"]) > 0
+    assert 0 < float(m["rel_volume"]) < 1.0
+    ckpt = str(tmp_path / "ckpt")
+    checkpoint.save(ckpt, state, config=cfg)
+    for r in range(1, 3):
+        state, m = fs.step(state, jax.random.fold_in(key, r))
+    assert all(
+        bool(jnp.all(jnp.isfinite(x)))
+        for x in jax.tree_util.tree_leaves(state.params)
+    )
+
+    # restore into a FRESH driver and replay: bitwise-identical params (the
+    # round is one deterministic jitted function of (state, key))
+    fs2, template = build()
+    state2 = checkpoint.restore(ckpt, template, config=cfg)
+    for r in range(1, 3):
+        state2, _ = fs2.step(state2, jax.random.fold_in(key, r))
+    assert _leaves_equal(state.params, state2.params)
+    assert _leaves_equal(state.residuals, state2.residuals)
+
+
+def test_fedsim_geometry_validation(mesh8):
+    params0, data_fn, loss_fn = synthetic_linear_problem(DIM, BATCH, LOCAL)
+    cfg = _cfg(fed=True, fed_num_clients=60, fed_clients_per_round=16)
+    with pytest.raises(ValueError, match="divide evenly"):
+        FedSim(loss_fn, cfg, cfg.fed_config(), optax.sgd(0.1), data_fn,
+               mesh=mesh8)
+    cfg = _cfg(fed=True, fed_num_clients=64, fed_clients_per_round=12)
+    with pytest.raises(ValueError, match="divide evenly"):
+        FedSim(loss_fn, cfg, cfg.fed_config(), optax.sgd(0.1), data_fn,
+               mesh=mesh8)
+    cfg = _cfg(fed=True, fed_num_clients=64, fed_clients_per_round=16)
+    with pytest.raises(ValueError, match="chunk"):
+        FedSim(loss_fn, cfg, cfg.fed_config(), optax.sgd(0.1), data_fn,
+               mesh=mesh8, client_chunk=3)
+
+
+# ---------------------------------------------------------------------- #
+# cost model + telemetry report
+# ---------------------------------------------------------------------- #
+
+
+def test_costmodel_fed_round_time():
+    from deepreduce_tpu import costmodel as cm
+
+    t1 = cm.fed_round_time(1000.0, 100)
+    assert t1 == pytest.approx(100 * 1000.0 / cm.BW_100MBPS)
+    assert cm.fed_round_time(1000.0, 200) > t1  # serialized server ingest
+    assert cm.fed_round_time(1000.0, 100, t_client_s=0.5) == pytest.approx(
+        t1 + 0.5
+    )
+    # doubling the server links halves the wire term
+    assert cm.fed_round_time(1000.0, 100, server_links=2) == pytest.approx(
+        t1 / 2
+    )
+    assert cm.fed_clients_per_sec(1000.0, 100) == pytest.approx(100 / t1)
+
+
+def test_telemetry_fedsim_report_rates():
+    from deepreduce_tpu.telemetry.__main__ import _fedsim_report
+
+    hist = [
+        {"ts": 100.0 + 2.0 * i, "round": i, "clients": 32.0,
+         "uplink_bytes": 2048.0, "checksum_failures": 1.0}
+        for i in range(5)
+    ]
+    rep = _fedsim_report(hist)
+    assert rep is not None
+    assert rep["clients_per_round"]["mean"] == pytest.approx(32.0)
+    assert rep["uplink_bytes_per_round"]["mean"] == pytest.approx(2048.0)
+    # 32 clients per 2s interval
+    assert rep["clients_per_sec"]["mean"] == pytest.approx(16.0)
+    assert rep["checksum_failures_total"] == pytest.approx(5.0)
+    assert _fedsim_report([{"ts": 1.0, "loss": 0.5}]) is None  # not a fed run

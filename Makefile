@@ -1,4 +1,4 @@
-.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check fedsim-check
+.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check fedsim-check ctrl-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
@@ -6,8 +6,17 @@
 # telemetry round trip (telemetry-check), the resilience smoke
 # (chaos-check) and the federated round smoke (fedsim-check) so none of
 # those paths can rot while the gate stays green.
-analyze: telemetry-check chaos-check fedsim-check
+analyze: telemetry-check chaos-check fedsim-check ctrl-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
+
+# adaptive-controller smoke: a short adaptive train on the 8-worker CPU
+# mesh asserts decisions.jsonl is non-empty and schema-valid, the
+# controller actually switches operating points with bounded re-jit
+# (compiled executables == ladder rungs visited), and a mid-run
+# checkpoint resume replays the decision trail BITWISE with bit-identical
+# final params (python -m deepreduce_tpu.controller check)
+ctrl-check:
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.controller --platform cpu check
 
 # federated-simulation smoke: a small client-sharded cohort run on the
 # 8-device CPU mesh with FaultPlan churn + wire corruption under payload

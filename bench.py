@@ -988,6 +988,122 @@ def fed_sweep(quick: bool = False, workers: int = 8) -> dict:
     }
 
 
+def ctrl_sweep(quick: bool = False, workers: int = 8) -> dict:
+    """The adaptive-controller convergence arm (`--ctrl-sweep`): one fixed
+    run per ladder rung vs one adaptive run on the same deterministic
+    synthetic task the ctrl check trains (identical data, seeds and step
+    count, so the arms differ ONLY in how compress_ratio is driven). Each
+    arm reports its converged loss and its average wire volume per step
+    (from the on-device accumulators), priced on the 100 Mbps cost model;
+    the adaptive arm adds its decision trail. The committed record
+    (BENCH_CTRL_r14.json) is the paper-trajectory evidence that the
+    controller matches the best fixed configuration's loss while moving
+    fewer bytes on average — it starts at the most expensive rung and
+    settles on the cheapest rung whose fidelity stays in the err_cos
+    band."""
+    import pathlib
+    import tempfile
+
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.controller import DecisionLog, Ladder
+    from deepreduce_tpu.controller.__main__ import _build_cfg, _run_train
+
+    cm = _costmodel()
+    # long enough that every rung's loss has plateaued — the matched-loss
+    # regime the adaptive-vs-fixed wire claim is stated in
+    steps = 160 if not quick else 24
+    tail = 10 if not quick else 4
+    ladder_spec = "0.01,0.02,0.05"
+    ladder = Ladder.parse(ladder_spec)
+    base = dict(
+        deepreduce="index", index="bloom", fpr=0.01, memory="residual",
+        min_compress_size=100, telemetry=True, telemetry_every=5,
+    )
+
+    def _arm(losses, trainer):
+        summ = trainer.telemetry_summary()
+        n = max(float(summ["steps"]), 1.0)
+        wire = float(summ["cumulative_total_bits"]) / 8.0 / n
+        return {
+            "final_loss": round(float(np.mean(losses[-tail:])), 6),
+            "best_loss": round(float(min(losses)), 6),
+            "wire_bytes_per_step": round(wire, 1),
+            "rel_volume": round(float(summ["rel_volume"]), 5),
+            "compress_err_cos": round(float(summ["compress_err_cos"]), 4),
+            "modeled_100mbps_exchange_s": round(
+                cm.allgather_time(wire, workers), 6
+            ),
+        }
+
+    arms = {}
+    for i in range(len(ladder)):
+        r = ladder[i].ratio
+        cfg = DeepReduceConfig(compress_ratio=r, **base)
+        _progress(f"ctrl-sweep: fixed ratio={r}: {steps} steps")
+        with _span(f"bench/ctrl-sweep/fixed/{r}"):
+            losses, trainer, _ = _run_train(cfg, steps=steps, num_workers=workers)
+        arms[f"fixed_{r}"] = {"compress_ratio": r, **_arm(losses, trainer)}
+
+    acfg = _build_cfg()
+    _progress(f"ctrl-sweep: adaptive (ladder {ladder_spec}): {steps} steps")
+    with tempfile.TemporaryDirectory(prefix="drtpu_ctrl_sweep_") as td:
+        log = pathlib.Path(td) / "decisions.jsonl"
+        with _span("bench/ctrl-sweep/adaptive"):
+            losses, trainer, _ = _run_train(
+                acfg, steps=steps, num_workers=workers, log_path=log
+            )
+        decisions = DecisionLog.read(log)
+    ctrl = trainer.controller
+    adaptive = {
+        "start_ratio": acfg.compress_ratio,
+        **_arm(losses, trainer),
+        "effective_ratio": round(ctrl.effective_ratio(), 5),
+        "switches": int(ctrl.switches),
+        "windows": int(ctrl.windows),
+        "visited_indices": list(trainer.visited_ladder_indices),
+        "trail": [
+            f"{d['step']}: {d['old_index']}->{d['new_index']} "
+            f"({d['trigger']}/{d['rationale']})"
+            for d in decisions
+            if d["switched"]
+        ],
+    }
+    arms["adaptive"] = adaptive
+
+    # the fixed arm the controller has to beat: best converged loss
+    fixed = {k: v for k, v in arms.items() if k != "adaptive"}
+    best = min(fixed, key=lambda k: fixed[k]["final_loss"])
+    wire_ratio = adaptive["wire_bytes_per_step"] / max(
+        fixed[best]["wire_bytes_per_step"], 1e-9
+    )
+    _progress(
+        f"ctrl-sweep: adaptive {adaptive['final_loss']} loss @ "
+        f"{adaptive['wire_bytes_per_step']} B/step vs best fixed [{best}] "
+        f"{fixed[best]['final_loss']} @ {fixed[best]['wire_bytes_per_step']}"
+    )
+    return {
+        "metric": "adaptive_ctrl_wire_vs_best_fixed",
+        "value": round(wire_ratio, 4),
+        "unit": "x (adaptive wire bytes/step over best fixed arm's)",
+        "platform": "cpu",
+        "detail": {
+            "steps": steps,
+            "workers": workers,
+            "ladder": ladder_spec,
+            "ctrl_target_err_cos": acfg.ctrl_target_err_cos,
+            "ctrl_headroom": acfg.ctrl_headroom,
+            "ctrl_hysteresis": acfg.ctrl_hysteresis,
+            "telemetry_every": acfg.telemetry_every,
+            "task": "deterministic synthetic MLP (the ctrl-check train)",
+            "best_fixed": best,
+            "loss_gap_vs_best_fixed": round(
+                adaptive["final_loss"] - fixed[best]["final_loss"], 6
+            ),
+            "arms": arms,
+        },
+    }
+
+
 def main() -> None:
     if _trace_out_path():
         from deepreduce_tpu.telemetry import spans
@@ -1035,6 +1151,14 @@ def main() -> None:
 
         force_platform("cpu", device_count=8)
         print(json.dumps(fed_sweep(quick="--quick" in sys.argv)))
+        return
+    if "--ctrl-sweep" in sys.argv:
+        # standalone adaptive-controller convergence arm: CPU-mesh only,
+        # one JSON record on stdout (committed as BENCH_CTRL_*.json)
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu", device_count=8)
+        print(json.dumps(ctrl_sweep(quick="--quick" in sys.argv)))
         return
     if "--rs-sweep" in sys.argv:
         # standalone in-collective sweep mode: CPU-mesh only, one JSON

@@ -178,13 +178,13 @@ def run(args) -> dict:
     from deepreduce_tpu.train import Trainer
 
     params = ast.literal_eval(args.grace_config) if args.grace_config else {}
+    # --telemetry must land before construction: config validation is
+    # cross-field (ctrl=True requires telemetry=True at __post_init__)
+    if args.telemetry:
+        params.setdefault("telemetry", True)
     # CLI-entered dicts get the strict treatment: a typo'd knob should kill
     # the run, not silently bench the default
     cfg = from_params(params, strict=True)
-    if args.telemetry and not cfg.telemetry:
-        import dataclasses
-
-        cfg = dataclasses.replace(cfg, telemetry=True)
     from deepreduce_tpu.telemetry import spans
 
     if cfg.telemetry:
@@ -231,6 +231,10 @@ def run(args) -> dict:
             config={"model": args.model, "workers": n_dev, **params},
             tags=[t for t in args.tags.split(",") if t],
         )
+    if cfg.ctrl and tracker is not None:
+        # the auditable decision trail: every controller evaluation lands
+        # in <run dir>/decisions.jsonl (telemetry trace/summary render it)
+        trainer.attach_decision_log(tracker.dir / "decisions.jsonl")
 
     ckpt_path = None
     if args.checkpoint_every or args.resume:
@@ -257,12 +261,18 @@ def run(args) -> dict:
             template["telemetry"] = MetricAccumulators.zeros(
                 trainer.exchanger.num_buckets
             )
+        if cfg.ctrl:
+            template["ctrl"] = trainer.controller_state()
         restored = checkpoint.restore(str(ckpt_path), template, config=cfg)
         state = restored["state"]
         if cfg.telemetry:
             # the accumulator resumes too: summaries keep counting from the
             # killed run's totals instead of restarting at zero
             trainer._telemetry_acc = restored["telemetry"]
+        if cfg.ctrl:
+            # the controller trajectory resumes bitwise: rung index, vote
+            # streaks, and the window baseline all come from the checkpoint
+            trainer.load_controller_state(restored["ctrl"])
         start_step = int(state.step)
         print(f"resumed from {ckpt_path} at step {start_step}", flush=True)
 
@@ -301,6 +311,8 @@ def run(args) -> dict:
                 payload = {"state": state}
                 if cfg.telemetry:
                     payload["telemetry"] = trainer._telemetry_acc
+                if cfg.ctrl:
+                    payload["ctrl"] = trainer.controller_state()
                 checkpoint.save(str(ckpt_path), payload, config=cfg)
             if tracker is not None:
                 rec = {"loss": losses[-1], "rel_volume": float(wire.rel_volume())}
@@ -366,6 +378,16 @@ def run(args) -> dict:
         result["telemetry"] = trainer.telemetry_summary()
         if tracker is not None:
             spans.get_tracer().save(tracker.dir / "trace.json")
+    if cfg.ctrl:
+        ctrl = trainer.controller
+        result["ctrl"] = {
+            "index": int(ctrl.index),
+            "ladder": list(ctrl.ladder.labels()),
+            "windows": int(ctrl.windows),
+            "switches": int(ctrl.switches),
+            "effective_ratio": ctrl.effective_ratio(),
+            "visited_indices": list(trainer.visited_ladder_indices),
+        }
     print(json.dumps(result))
     if tracker is not None:
         tracker.finish(result)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from deepreduce_tpu import memory
 from deepreduce_tpu.analysis.rules import (
     AuditContext,
+    R_CTRL_LADDER,
     R_RESILIENCE_OFF,
     R_RETRACE,
     Violation,
@@ -578,9 +579,103 @@ def _per_tensor_expected_gathers(cfg: DeepReduceConfig, d: int) -> int:
     return len(jax.tree_util.tree_leaves(payload_sds))
 
 
+def audit_ctrl_ladder(*, d: int = 4096) -> List[TraceRecord]:
+    """The adaptive controller's bounded-re-jit contract, on the trace.
+
+    The controller only ever moves along a pre-declared discrete ladder of
+    operating points, and each rung builds ONE exchanger — so the whole
+    adaptive run compiles at most len(ladder) step executables. This audit
+    traces the flagship fused exchange at every rung (each trace runs the
+    full rule set, including jx-callback: the controller must add no host
+    callbacks to the step program) and pins two hash facts with
+    jx-ctrl-ladder:
+
+    - cardinality: the rungs trace to exactly len(ladder) DISTINCT jaxpr
+      hashes — no accidental collisions (a rung that silently compiles to
+      another rung's program would mean the ladder is lying about its
+      resolution) and trivially no more than len(ladder) variants;
+    - off-identity: a ctrl=True config at a rung traces byte-identical to
+      a plain fixed config at the same operating point — the controller is
+      host-side Python only and leaves zero residue in the traced program.
+    """
+    import hashlib
+
+    from deepreduce_tpu.controller.ladder import Ladder
+
+    base = dict(memory="residual", decode_strategy="loop", **_FLAGSHIP)
+    cfg = DeepReduceConfig(
+        telemetry=True, ctrl=True, ctrl_ladder=_CTRL_LADDER, **base
+    )
+    ladder = Ladder.parse(cfg.ctrl_ladder)
+    records: List[TraceRecord] = []
+    hashes: List[str] = []
+    for i in range(len(ladder)):
+        (rec,) = audit_exchange(
+            f"ctrl:ladder[{i}]",
+            ladder.apply(cfg, i),
+            d=d,
+            expect={"all_gather": 1},
+            wire_mode="allgather",
+        )
+        hashes.append(rec.jaxpr_hash)
+        records.append(rec)
+
+    violations: List[Violation] = []
+    if len(set(hashes)) != len(ladder):
+        violations.append(
+            Violation(
+                R_CTRL_LADDER,
+                "ctrl:ladder-cardinality",
+                f"{len(ladder)} ladder rungs traced to "
+                f"{len(set(hashes))} distinct jaxpr hashes ({hashes}) — "
+                "bounded re-jit requires exactly one executable per rung",
+            )
+        )
+    # off-identity at rung 0: same operating point, no ctrl knobs at all
+    pt = ladder[0]
+    off = DeepReduceConfig(
+        telemetry=True,
+        **{**base, "compress_ratio": pt.ratio,
+           **({} if pt.fpr is None else {"fpr": pt.fpr})},
+    )
+    (rec_off,) = audit_exchange(
+        "ctrl:off-identical", off, d=d,
+        expect={"all_gather": 1}, wire_mode="allgather",
+    )
+    if rec_off.jaxpr_hash != hashes[0]:
+        violations.append(
+            Violation(
+                R_CTRL_LADDER,
+                "ctrl:off-identical",
+                f"ctrl=True trace at rung 0 ({hashes[0]}) differs from the "
+                f"fixed-config trace at the same operating point "
+                f"({rec_off.jaxpr_hash}) — the controller must be host-side "
+                "only",
+            )
+        )
+    records.append(rec_off)
+    records.append(
+        TraceRecord(
+            label="ctrl:ladder-cardinality",
+            violations=violations,
+            collectives={},
+            # a stable digest over the per-rung hashes: re-baselining
+            # catches any rung's program changing even via this record
+            jaxpr_hash=hashlib.sha256(
+                "".join(hashes).encode()
+            ).hexdigest()[:16],
+        )
+    )
+    return records
+
+
 # ---------------------------------------------------------------------- #
 # the audited configuration inventory
 # ---------------------------------------------------------------------- #
+
+# the ladder the ctrl audits and tier-1 adaptive tests pin (matches the
+# controller check CLI)
+_CTRL_LADDER = "0.01,0.02,0.05"
 
 _FLAGSHIP = dict(
     deepreduce="index",
@@ -954,6 +1049,10 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
               min_compress_size=100),
         ),
     )
+    # --- the adaptive controller's ladder: one executable per rung,
+    # distinct hashes, zero traced residue (registered last so the
+    # pre-existing record order — and ANALYSIS.json hashes — are stable) ---
+    add("ctrl:ladder", lambda: audit_ctrl_ladder())
     return specs
 
 

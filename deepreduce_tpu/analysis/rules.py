@@ -44,6 +44,13 @@ R_RETRACE = "jx-retrace"  # emitted by the audit harness (two-trace hash)
 # step program must trace to a byte-identical jaxpr with every resilience
 # seam (mask / chaos / checksum) stubbed out — the zero-cost-off contract
 R_RESILIENCE_OFF = "jx-resilience-off-identical"
+# emitted by the audit harness (audit_ctrl_ladder): the adaptive
+# controller's bounded-re-jit contract — the ladder's rungs must trace to
+# exactly len(ladder) distinct jaxpr hashes (each rung one executable, no
+# accidental collisions and no hidden extra variants), and a ctrl=True
+# config at a rung must trace byte-identical to a plain fixed config at
+# the same operating point (the controller is host-side only)
+R_CTRL_LADDER = "jx-ctrl-ladder"
 
 ALL_RULE_IDS = (
     R_F64,
@@ -56,6 +63,7 @@ ALL_RULE_IDS = (
     R_CODEC_COUNT,
     R_RETRACE,
     R_RESILIENCE_OFF,
+    R_CTRL_LADDER,
 )
 
 # sparsifier-selection primitives: every TensorCodec encode lowers its

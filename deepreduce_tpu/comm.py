@@ -240,6 +240,7 @@ class GradientExchanger:
         *,
         axis_name: str = "data",
         num_workers: Optional[int] = None,
+        bucket_points: Optional[Any] = None,
     ):
         self.cfg = cfg
         self.axis_name = axis_name
@@ -355,8 +356,16 @@ class GradientExchanger:
                 [leaf.shape for _, leaf in leaves],
                 cfg,
                 axis_name=axis_name,
+                points=bucket_points,
             )
         else:
+            if bucket_points is not None:
+                raise ValueError(
+                    "bucket_points is the adaptive controller's per-bucket "
+                    "(ratio, fpr) vector for the BUCKETED exchange and would "
+                    "be silently ignored without bucket_bytes — set "
+                    "bucket_bytes, or bucket_points=None"
+                )
             self.codecs = {
                 name: TensorCodec(leaf.shape, cfg, name=name)
                 for name, (path, leaf) in zip(self.names, leaves)

@@ -169,6 +169,7 @@ class BucketedExchanger:
         cfg: DeepReduceConfig,
         *,
         axis_name: str,
+        points=None,
     ):
         self.cfg = cfg
         self.axis_name = axis_name
@@ -179,18 +180,43 @@ class BucketedExchanger:
         self.specs: Tuple[BucketSpec, ...] = tuple(
             partition_buckets(list(names), sizes, cfg.bucket_bytes)
         )
+        # per-bucket operating points from the adaptive controller's ladder:
+        # a (ratio, fpr-or-None) pair per bucket, in spec order, overriding
+        # the config's global ratio/fpr for that bucket's codec and slot
+        # budget. The partition above never depends on the points (it is a
+        # pure function of (name, size, bucket_bytes)), so the bucket count
+        # and spec order are identical across every ladder rung — which is
+        # what lets residuals and accumulators carry across rung switches.
+        if points is not None and len(points) != len(self.specs):
+            raise ValueError(
+                f"points must carry one (ratio, fpr) per bucket: got "
+                f"{len(points)} for {len(self.specs)} buckets"
+            )
+        self.points = None if points is None else tuple(
+            (float(r), None if f is None else float(f)) for r, f in points
+        )
         self.codecs: Dict[str, TensorCodec] = {}
         self.layouts: Dict[str, PayloadLayout] = {}
         self.payload_nbytes = 0
-        for spec in self.specs:
+        for b, spec in enumerate(self.specs):
+            ratio, fpr = (
+                (cfg.compress_ratio, cfg.fpr)
+                if self.points is None
+                else self.points[b]
+            )
+            cfg_b = cfg if self.points is None else dataclasses.replace(
+                cfg,
+                compress_ratio=ratio,
+                **({} if fpr is None else {"fpr": fpr}),
+            )
             # The bucket's slot budget is the SUM of its member leaves'
             # per-tensor budgets, so fusing never changes the total wire
             # budget (per-leaf rounding and the max(1, .) floor included).
             codec = TensorCodec(
                 (spec.total,),
-                cfg,
+                cfg_b,
                 name=spec.label,
-                slots=bucket_num_slots(spec.sizes, cfg.compress_ratio),
+                slots=bucket_num_slots(spec.sizes, ratio),
             )
             payload_sds = jax.eval_shape(
                 lambda g, c=codec: c.encode(g, step=0, key=jax.random.PRNGKey(0)),

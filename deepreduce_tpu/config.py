@@ -235,6 +235,37 @@ class DeepReduceConfig:
     # this many vmapped clients per worker instead of one [C_local, ...]
     # batch (must divide the per-worker cohort). 0 = single vmap block.
     fed_client_chunk: int = 0
+    # adaptive compression controller (deepreduce_tpu.controller): every
+    # `telemetry_every` steps the Trainer feeds the fetched
+    # MetricAccumulators window delta to a host-side controller that moves
+    # compress_ratio/fpr along the discrete `ctrl_ladder` of pre-declared
+    # operating points — one static step program per rung, so re-jit is
+    # bounded at len(ladder) (pinned by the jx-ctrl-ladder analysis rule).
+    # Off by default: the ctrl-off step program is byte-identical to a
+    # build without the subsystem. Requires telemetry=True (the controller
+    # reads only the fetch the trainer was already doing — zero extra
+    # hot-loop syncs).
+    ctrl: bool = False
+    # the operating-point ladder: comma-separated `ratio` or `ratio@fpr`
+    # entries with strictly increasing ratios (controller/ladder.py). The
+    # run starts at the rung nearest compress_ratio and moves ±1 rung per
+    # decision.
+    ctrl_ladder: str = "0.005,0.01,0.02,0.05"
+    # window mean compress-error cosine the controller defends: below it
+    # the controller votes for more wire budget (a higher rung)
+    ctrl_target_err_cos: float = 0.97
+    # fidelity surplus before spending it: window err_cos must exceed
+    # target + headroom before the controller votes to step down a rung
+    ctrl_headroom: float = 0.015
+    # saturated payloads per step above which the controller votes up
+    # regardless of err_cos. Effectively disabled by default (1e9): top-k
+    # selection fills its budget by construction (nsel == k flags every
+    # payload every step), so saturation is an anomaly signal only for the
+    # threshold-superset encodes — set a small finite ceiling with those
+    ctrl_saturation_ceiling: float = 1e9
+    # consecutive same-direction votes required before a move; any hold or
+    # opposite vote resets the streak (anti-oscillation)
+    ctrl_hysteresis: int = 2
 
     # the documented enumerations (comments above + codecs/registry.py).
     # __post_init__ checks against these so a typo like
@@ -515,6 +546,66 @@ class DeepReduceConfig:
                     f"fed_clients_per_round={self.fed_clients_per_round} "
                     "(the chunked cohort scan needs equal blocks)"
                 )
+        # --- adaptive controller: loud failure for silently-ignored knobs ---
+        ctrl_engaged = [
+            name
+            for name, default in (
+                ("ctrl_ladder", type(self).ctrl_ladder),
+                ("ctrl_target_err_cos", type(self).ctrl_target_err_cos),
+                ("ctrl_headroom", type(self).ctrl_headroom),
+                ("ctrl_saturation_ceiling", type(self).ctrl_saturation_ceiling),
+                ("ctrl_hysteresis", type(self).ctrl_hysteresis),
+            )
+            if getattr(self, name) != default
+        ]
+        if ctrl_engaged and not self.ctrl:
+            raise ValueError(
+                f"{', '.join(ctrl_engaged)} configure the adaptive "
+                "compression controller and would be silently ignored with "
+                "ctrl=False — set ctrl=True (or drop the knob(s))"
+            )
+        if self.ctrl:
+            if not self.telemetry:
+                raise ValueError(
+                    "ctrl=True requires telemetry=True: the controller "
+                    "consumes the MetricAccumulators fetch and adds no "
+                    "syncs of its own"
+                )
+            if self.compressor == "none":
+                raise ValueError(
+                    "ctrl=True has nothing to tune with compressor='none' "
+                    "(no sparsifier budget); pick a sparsifying compressor"
+                )
+            if self.hier or self.fed:
+                raise ValueError(
+                    "ctrl=True currently drives the flat GradientExchanger "
+                    "only — it cannot rebuild the hierarchical or federated "
+                    "pipelines per rung (hier=False, fed=False required)"
+                )
+            if not 0.0 < self.ctrl_target_err_cos <= 1.0:
+                raise ValueError(
+                    "ctrl_target_err_cos must be in (0, 1], got "
+                    f"{self.ctrl_target_err_cos}"
+                )
+            if self.ctrl_headroom < 0.0:
+                raise ValueError(
+                    f"ctrl_headroom must be >= 0, got {self.ctrl_headroom}"
+                )
+            if self.ctrl_saturation_ceiling < 0.0:
+                raise ValueError(
+                    "ctrl_saturation_ceiling must be >= 0, got "
+                    f"{self.ctrl_saturation_ceiling}"
+                )
+            if self.ctrl_hysteresis < 1:
+                raise ValueError(
+                    f"ctrl_hysteresis must be >= 1, got {self.ctrl_hysteresis}"
+                )
+            # ladder syntax check at construction (deferred import:
+            # controller/ladder.py imports this module, so import lazily
+            # here to avoid the cycle — mirrors the FaultPlan.parse idiom)
+            from deepreduce_tpu.controller.ladder import Ladder
+
+            Ladder.parse(self.ctrl_ladder)
 
     def fed_config(self):
         """The round-geometry view of the fed_* knobs (deferred import:

@@ -1,0 +1,27 @@
+"""Adaptive compression controller (see ARCHITECTURE.md "Adaptive controller").
+
+`Ladder` declares the bounded set of operating points; `CompressionController`
+moves a single index along it from telemetry window deltas; `DecisionLog`
+persists the auditable trail as ``decisions.jsonl``.
+"""
+
+from deepreduce_tpu.controller.controller import (
+    DECISION_SCHEMA,
+    CompressionController,
+    DecisionLog,
+    RATIONALES,
+    TRIGGERS,
+    validate_decision,
+)
+from deepreduce_tpu.controller.ladder import Ladder, OperatingPoint
+
+__all__ = [
+    "CompressionController",
+    "DecisionLog",
+    "DECISION_SCHEMA",
+    "Ladder",
+    "OperatingPoint",
+    "RATIONALES",
+    "TRIGGERS",
+    "validate_decision",
+]

@@ -14,13 +14,22 @@ offline consumer of tracking.py run directories.
                              `decode_strategy` config); exits 1 on a
                              step-time regression — the bench trajectory's
                              automated consumer.
+- ``compare RUN_A RUN_B --ctrl``
+                             adaptive-vs-fixed diff: cumulative wire
+                             volume at matched (running-min) loss; exits 1
+                             when the adaptive run (A) spent >= wire than
+                             the fixed baseline (B).
 - ``trace RUN [--out F]``    merged Chrome trace: the run's span events
                              (trace.json, written by benchmarks/train.py
                              --telemetry) plus per-step metrics as "C"
-                             counter events. Load the output in Perfetto.
+                             counter events; adaptive runs additionally get
+                             ctrl_ladder_index/ctrl_ratio counter tracks and
+                             instant markers at each operating-point switch.
+                             Load the output in Perfetto.
 
-RUN may be a run directory or a tracking root (latest run is picked).
-Exit codes: 0 ok, 1 flagged regression, 2 usage/data error.
+Runs with telemetry off get a clean "telemetry was off" notice instead of
+partial output. RUN may be a run directory or a tracking root (latest run
+is picked). Exit codes: 0 ok, 1 flagged regression, 2 usage/data error.
 """
 
 from __future__ import annotations
@@ -70,6 +79,29 @@ def _load_json(path: pathlib.Path) -> Dict[str, Any]:
         return {}
     with open(path) as f:
         return json.load(f)
+
+
+def _decisions(run: pathlib.Path) -> List[Dict[str, Any]]:
+    """The adaptive controller's decisions.jsonl trail ([] when absent)."""
+    path = run / "decisions.jsonl"
+    if not path.exists():
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _telemetry_off(run: pathlib.Path, summ: Dict[str, Any]) -> bool:
+    """True when the run recorded no telemetry artifacts at all: no device
+    accumulators in summary.json and no span trace. Used to print a clean
+    'telemetry was off' notice instead of silently partial output."""
+    return not isinstance(summ.get("telemetry"), dict) and not (
+        run / "trace.json"
+    ).exists()
 
 
 def _series(hist: List[Dict[str, Any]], key: str) -> List[float]:
@@ -132,10 +164,48 @@ def _run_report(run: pathlib.Path) -> Dict[str, Any]:
     telem = summ.get("telemetry")
     if isinstance(telem, dict):
         report["telemetry"] = telem
+    if _telemetry_off(run, summ):
+        report["telemetry_off"] = True
     fedsim = _fedsim_report(hist)
     if fedsim is not None:
         report["fedsim"] = fedsim
+    ctrl = _ctrl_report(run)
+    if ctrl is not None:
+        report["ctrl"] = ctrl
     return report
+
+
+def _ctrl_report(run: pathlib.Path) -> Optional[Dict[str, Any]]:
+    """Adaptive-controller digest from decisions.jsonl (None when the run
+    had no controller). `effective_ratio` is the step-weighted mean of the
+    rung each window actually ran at (old_ratio — the switch takes effect
+    for the NEXT window); `ctrl_switches_per_step` normalizes switch churn
+    by the decision span so runs of different lengths compare."""
+    decs = _decisions(run)
+    if not decs:
+        return None
+    switches = [d for d in decs if d.get("switched")]
+    span = max((int(d.get("step", 0)) for d in decs), default=0)
+    wsum = sum(float(d.get("window_steps", 0)) for d in decs)
+    wratio = sum(
+        float(d.get("window_steps", 0)) * float(d.get("old_ratio", 0.0))
+        for d in decs
+    )
+    last = decs[-1]
+    out: Dict[str, Any] = {
+        "decisions": len(decs),
+        "switches": len(switches),
+        "ctrl_switches_per_step": len(switches) / span if span else 0.0,
+        "effective_ratio": wratio / wsum if wsum else None,
+        "final_index": last.get("new_index"),
+        "final_ratio": last.get("new_ratio"),
+        "trail": [
+            f"{d.get('step')}: {d.get('old_index')}->{d.get('new_index')} "
+            f"({d.get('trigger')}/{d.get('rationale')})"
+            for d in switches
+        ],
+    }
+    return out
 
 
 def _fedsim_report(hist: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -209,10 +279,27 @@ def cmd_summary(args) -> int:
             print(
                 f"    checksum_failures_total: {fed['checksum_failures_total']:.6g}"
             )
+    if "ctrl" in rep:
+        ctrl = rep["ctrl"]
+        print("  ctrl (adaptive compression controller):")
+        print(
+            f"    decisions: {ctrl['decisions']}  switches: {ctrl['switches']}"
+            f"  final: rung {ctrl['final_index']} (ratio {ctrl['final_ratio']})"
+        )
+        print(f"    ctrl_switches_per_step: {ctrl['ctrl_switches_per_step']:.6g}")
+        if ctrl["effective_ratio"] is not None:
+            print(f"    effective_ratio: {ctrl['effective_ratio']:.6g}")
+        for line in ctrl["trail"]:
+            print(f"    switch {line}")
     if "telemetry" in rep:
         print("  device accumulators:")
         for k, v in sorted(rep["telemetry"].items()):
             print(f"    {k}: {v:.6g}" if isinstance(v, float) else f"    {k}: {v}")
+    elif rep.get("telemetry_off"):
+        print(
+            "  telemetry: was off for this run — no device accumulators or "
+            "span trace (re-run with --telemetry to record them)"
+        )
     return 0
 
 
@@ -227,6 +314,62 @@ def _bench_step_time(bench: Dict[str, Any], strategy: str) -> Optional[float]:
     if isinstance(rec, dict) and isinstance(rec.get("t_step_s"), (int, float)):
         return float(rec["t_step_s"])
     return None
+
+
+def _wire_to_loss(hist: List[Dict[str, Any]], target: float):
+    """(cumulative rel_volume, step) at the first record whose running-min
+    loss reaches `target`, or None if the run never gets there. rel_volume
+    is proportional to wire bytes when both runs share the model, so the
+    cumulative sum compares total gradient traffic at matched loss."""
+    best = float("inf")
+    wire = 0.0
+    for rec in hist:
+        rv = rec.get("rel_volume")
+        loss = rec.get("loss")
+        if isinstance(rv, (int, float)):
+            wire += float(rv)
+        if isinstance(loss, (int, float)):
+            best = min(best, float(loss))
+            if best <= target:
+                return wire, int(rec.get("step", 0))
+    return None
+
+
+def _compare_ctrl(run_a, rep_a, run_b, rep_b) -> int:
+    """`compare A B --ctrl`: A is the adaptive run, B the fixed baseline.
+    Matched-loss wire comparison — target is the WORSE of the two best
+    (running-min) losses, so both runs provably reached it; exits 1 when
+    the adaptive run spent at least as much wire getting there."""
+    hist_a = _history(run_a)
+    hist_b = _history(run_b)
+    loss_a = _series(hist_a, "loss")
+    loss_b = _series(hist_b, "loss")
+    if not loss_a or not loss_b:
+        return _fail("--ctrl compare needs loss series in both runs")
+    target = max(min(loss_a), min(loss_b))
+    at_a = _wire_to_loss(hist_a, target)
+    at_b = _wire_to_loss(hist_b, target)
+    if at_a is None or at_b is None:
+        return _fail("--ctrl compare: a run never reached the matched loss")
+    wire_a, step_a = at_a
+    wire_b, step_b = at_b
+    ctrl_a = rep_a.get("ctrl")
+    print(f"adaptive: {rep_a['run']}   fixed: {rep_b['run']}")
+    print(f"  matched loss target: {target:.6g}")
+    print(f"  adaptive: reached at step {step_a}, cum rel_volume {wire_a:.6g}")
+    print(f"  fixed:    reached at step {step_b}, cum rel_volume {wire_b:.6g}")
+    if ctrl_a:
+        er = ctrl_a.get("effective_ratio")
+        if er is not None:
+            print(f"  adaptive effective_ratio: {er:.6g} "
+                  f"({ctrl_a['switches']} switches)")
+    if wire_b > 0:
+        print(f"  wire adaptive/fixed: {wire_a / wire_b:.3f}x")
+    if wire_a >= wire_b:
+        print("  REGRESSION: adaptive run spent >= wire of fixed at matched loss")
+        return 1
+    print("  ok: adaptive reached matched loss on less wire")
+    return 0
 
 
 def cmd_compare(args) -> int:
@@ -265,6 +408,9 @@ def cmd_compare(args) -> int:
         return _fail(f"no run directory under {args.run_b!r}")
     rep_b = _run_report(run_b)
     t_b = rep_b["step_time_s"].get("mean")
+
+    if args.ctrl:
+        return _compare_ctrl(run_a, rep_a, run_b, rep_b)
     print(f"A: {rep_a['run']}   B: {rep_b['run']}")
     print(f"  step_time A: {_fmt_dist(rep_a['step_time_s'], 's')}")
     print(f"  step_time B: {_fmt_dist(rep_b['step_time_s'], 's')}")
@@ -313,8 +459,65 @@ def cmd_trace(args) -> int:
                     "args": {key: float(val)},
                 }
             )
+    # adaptive-controller decisions ride along as their own counter tracks
+    # (ladder index + active ratio) plus global instant markers at each
+    # switch; decision steps are mapped to wall time via metrics.jsonl
+    decs = _decisions(run)
+    if decs and ts0 is not None:
+        step_ts = {
+            int(r["step"]): r["ts"]
+            for r in hist
+            if isinstance(r.get("step"), (int, float)) and "ts" in r
+        }
+        max_known = max(step_ts) if step_ts else 0
+        for d in decs:
+            step = int(d.get("step", 0))
+            # decisions carry no wall clock by design (bitwise replay);
+            # anchor at the nearest logged step at or before the decision
+            anchor = step if step in step_ts else min(step, max_known)
+            while anchor > 0 and anchor not in step_ts:
+                anchor -= 1
+            ts = round((step_ts.get(anchor, ts0) - ts0) * 1e6, 3)
+            for name, val in (
+                ("ctrl_ladder_index", d.get("new_index")),
+                ("ctrl_ratio", d.get("new_ratio")),
+            ):
+                if isinstance(val, (int, float)):
+                    events.append(
+                        {"name": name, "ph": "C", "ts": ts, "pid": 1, "tid": 0,
+                         "args": {name: float(val)}}
+                    )
+            if d.get("switched"):
+                events.append(
+                    {
+                        "name": (
+                            f"ctrl switch {d.get('old_index')}->"
+                            f"{d.get('new_index')} ({d.get('rationale')})"
+                        ),
+                        "ph": "i", "s": "g", "ts": ts, "pid": 1, "tid": 0,
+                        "args": {
+                            "trigger": d.get("trigger"),
+                            "old_ratio": d.get("old_ratio"),
+                            "new_ratio": d.get("new_ratio"),
+                        },
+                    }
+                )
     if not events:
+        summ = _load_json(run / "summary.json")
+        if _telemetry_off(run, summ):
+            print(
+                f"telemetry: run {run.name}: telemetry was off for this run — "
+                "no span trace or metrics to export (re-run with --telemetry)"
+            )
+            return 0
         return _fail(f"run {run.name} has neither trace.json events nor metrics")
+    if not trace.get("traceEvents"):
+        print(
+            f"telemetry: note: run {run.name} has no span trace "
+            "(telemetry was off or trace.json missing); exporting metric "
+            "counters only",
+            file=sys.stderr,
+        )
     events.sort(key=lambda e: e.get("ts", 0.0))
     merged = {"traceEvents": events, "displayTimeUnit": "ms"}
     if args.out and args.out != "-":
@@ -351,6 +554,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "matched on the run's decode_strategy")
     p.add_argument("--tol", type=float, default=0.10,
                    help="step-time regression tolerance (default 10%%)")
+    p.add_argument("--ctrl", action="store_true",
+                   help="adaptive-vs-fixed mode: RUN_A is the adaptive run, "
+                        "RUN_B the fixed baseline; compares cumulative wire "
+                        "volume at matched (running-min) loss and exits 1 "
+                        "when adaptive spent >= wire")
     p.set_defaults(fn=cmd_compare)
 
     p = sub.add_parser("trace", help="merged Chrome trace JSON (Perfetto)")

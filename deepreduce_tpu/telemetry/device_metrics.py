@@ -19,7 +19,7 @@ dense_bits is step-constant (it is — shapes are static)."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +28,25 @@ import numpy as np
 from deepreduce_tpu.metrics import WireStats
 
 _EPS = 1e-12
+
+
+def fetch_delta(cur: Dict[str, Any], prev: Dict[str, Any]) -> Dict[str, Any]:
+    """Elementwise `cur - prev` of two cumulative `fetch()` snapshots —
+    the per-window counters the adaptive controller consumes. Because
+    every field is a running sum, the delta of two fetches IS the exact
+    accumulation over the steps between them (pinned in
+    tests/test_telemetry.py)."""
+    out: Dict[str, Any] = {}
+    for name in MetricAccumulators.scalar_fields():
+        out[name] = cur[name] - prev[name]
+    cur_b = cur.get("bucket_saturated", [])
+    prev_b = prev.get("bucket_saturated", [])
+    if len(cur_b) != len(prev_b):
+        raise ValueError(
+            f"fetch_delta bucket vector length mismatch: {len(cur_b)} vs {len(prev_b)}"
+        )
+    out["bucket_saturated"] = [c - p for c, p in zip(cur_b, prev_b)]
+    return out
 
 
 @jax.tree_util.register_dataclass
@@ -127,22 +146,40 @@ class MetricAccumulators:
         cumulatively — the empirical check of the configured `fpr`."""
         return self.fp_count / jnp.maximum(self.fp_universe, 1.0)
 
-    def summary(self) -> Dict[str, float]:
-        """Fetch to host and reduce to plain floats (the telemetry_every
-        sync point; also what the CLI prints)."""
-        vals = {
-            f.name: float(np.asarray(getattr(self, f.name)))
-            for f in dataclasses.fields(self)
-            if f.name != "bucket_saturated"  # vector-valued, handled below
+    @classmethod
+    def scalar_fields(cls) -> Tuple[str, ...]:
+        """Field names of the scalar counters, in declaration order
+        (everything except the vector-valued `bucket_saturated`)."""
+        return tuple(
+            f.name for f in dataclasses.fields(cls) if f.name != "bucket_saturated"
+        )
+
+    def fetch(self) -> Dict[str, Any]:
+        """Materialise the cumulative counters to host plain floats —
+        the telemetry_every sync point. Scalars by field name, plus
+        `bucket_saturated` as a list of floats."""
+        vals: Dict[str, Any] = {
+            name: float(np.asarray(getattr(self, name)))
+            for name in self.scalar_fields()
         }
+        vals["bucket_saturated"] = [
+            float(v)
+            for v in np.asarray(self.bucket_saturated, np.float32).reshape(-1)
+        ]
+        return vals
+
+    @staticmethod
+    def derive(vals: Dict[str, Any]) -> Dict[str, Any]:
+        """Reduce a fetched (or delta'd) counter dict to the reported
+        ratios/rates. Applied to a cumulative `fetch()` this is the
+        classic summary; applied to a `fetch_delta()` it is the same
+        rates over one telemetry window."""
         steps = max(vals["steps"], 1.0)
         dense = max(vals["dense_bits"], _EPS)
-        bucket_sat = np.asarray(self.bucket_saturated, np.float32).reshape(-1)
-        out = {}
-        if bucket_sat.size:
-            out["bucket_saturated_per_step"] = [
-                float(v) / steps for v in bucket_sat
-            ]
+        bucket_sat = vals.get("bucket_saturated", [])
+        out: Dict[str, Any] = {}
+        if len(bucket_sat):
+            out["bucket_saturated_per_step"] = [float(v) / steps for v in bucket_sat]
         return out | {
             "steps": vals["steps"],
             "cumulative_total_bits": vals["index_bits"] + vals["value_bits"],
@@ -169,3 +206,16 @@ class MetricAccumulators:
             "dcn_bytes_per_step": (vals["index_bits"] + vals["value_bits"])
             / 8.0 / steps,
         }
+
+    def summary(self, prev: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Fetch to host and reduce to plain floats (also what the CLI
+        prints). With `prev` — a previous `fetch()` snapshot — the result
+        additionally carries every rate in per-window delta form under
+        `window_*` keys, so the controller's inputs and the human-readable
+        rows agree by construction."""
+        vals = self.fetch()
+        out = self.derive(vals)
+        if prev is not None:
+            window = self.derive(fetch_delta(vals, prev))
+            out.update({f"window_{k}": v for k, v in window.items()})
+        return out

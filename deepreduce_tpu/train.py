@@ -277,6 +277,28 @@ class Trainer:
         self._step_fn = None
         self._raw_step_fn = None  # unjitted shard_map'd fn (audit hook)
         self._telemetry_acc: Optional[MetricAccumulators] = None
+        # last fetched cumulative counters, baseline for the window_* rows
+        self._prev_summary_fetch = None
+        # --- adaptive controller (cfg.ctrl) ---------------------------- #
+        # One exchanger + one jitted step PER LADDER RUNG, built lazily and
+        # cached by rung index: the controller only ever swaps which cached
+        # program runs, so the compiled-executable count is bounded by
+        # len(ladder) (pinned by tests/test_controller.py and the
+        # jx-ctrl-ladder audit). All of it is Python-level and absent when
+        # ctrl=False — the off step program stays byte-identical.
+        self._ctrl = None
+        self._step_cache = {}
+        self._raw_step_cache = {}
+        self._exchanger_cache = {}
+        self._params_like = None
+        # host-side mirror of state.step: synced from the device ONCE at
+        # the first step() (resume-safe), then incremented locally — so the
+        # telemetry-boundary check never adds a per-step host sync
+        self._host_step = None
+        if cfg.ctrl:
+            from deepreduce_tpu.controller import CompressionController
+
+            self._ctrl = CompressionController(cfg)
 
     @property
     def num_workers(self) -> int:
@@ -295,6 +317,7 @@ class Trainer:
             variables = self.model.init(rng, sample_input)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
+        self._params_like = params
         if self.cfg.hier:
             from deepreduce_tpu.parallel.hierarchical import HierarchicalExchanger
 
@@ -303,6 +326,11 @@ class Trainer:
                 num_slices=self.mesh.shape["dcn"],
                 per_slice=self.mesh.shape["ici"],
             )
+        elif self._ctrl is not None:
+            # start at the rung nearest cfg.compress_ratio; residual and
+            # opt-state shapes are rung-invariant (dense gradient shapes),
+            # so the state built here carries across every rung switch
+            self.exchanger = self._exchanger_for(self._ctrl.index)
         else:
             self.exchanger = GradientExchanger(
                 params, self.cfg, axis_name=self.axis_name,
@@ -314,13 +342,69 @@ class Trainer:
             residuals = jax.tree_util.tree_map(
                 lambda r: jnp.broadcast_to(r[None], (self.num_workers,) + r.shape), residuals
             )
-        return TrainState(
+        state = TrainState(
             params=params,
             batch_stats=batch_stats,
             opt_state=self.optimizer.init(params),
             residuals=residuals,
             step=jnp.asarray(0, jnp.int32),
         )
+        if self._ctrl is not None:
+            # commit the fresh state to the exact shardings the jitted step
+            # emits (replicated carries, worker-sharded residuals): an
+            # uncommitted first-step input would specialize one extra
+            # throwaway executable, breaking the one-executable-per-rung
+            # accounting the controller audits and tests pin
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            state = jax.device_put(
+                dataclasses.replace(state, residuals=None),
+                NamedSharding(self.mesh, PartitionSpec()),
+            )
+            if residuals is not None:
+                residuals = jax.device_put(
+                    residuals, NamedSharding(self.mesh, PartitionSpec(self.axis_name))
+                )
+            state = dataclasses.replace(state, residuals=residuals)
+        return state
+
+    def _exchanger_for(self, idx: int) -> GradientExchanger:
+        """The (cached) flat exchanger for ladder rung `idx`: the base
+        config with the rung's ratio/fpr substituted, plus the per-bucket
+        operating-point vector once the bucket count is known (uniform
+        under the default all-buckets-together policy)."""
+        ex = self._exchanger_cache.get(idx)
+        if ex is None:
+            cfg_i = self._ctrl.ladder.apply(self.cfg, idx)
+            # first build discovers the bucket partition; later rungs thread
+            # the explicit per-bucket point vector through comm_bucket
+            points = None
+            if self.exchanger is not None and self.exchanger.num_buckets:
+                pt = self._ctrl.ladder[idx]
+                points = tuple(
+                    (pt.ratio, pt.fpr) for _ in range(self.exchanger.num_buckets)
+                )
+            ex = GradientExchanger(
+                self._params_like, cfg_i, axis_name=self.axis_name,
+                num_workers=self.num_workers, bucket_points=points,
+            )
+            self._exchanger_cache[idx] = ex
+        return ex
+
+    def _control_update(self):
+        """One controller evaluation at a telemetry fetch boundary: fetch
+        the cumulative counters (the sync that telemetry_every already
+        pays), let the controller vote on the window delta, and on a
+        switch swap in the cached exchanger/step for the new rung."""
+        with spans.span("ctrl/update"):
+            fetch = self._telemetry_acc.fetch()
+            decision = self._ctrl.observe(self._host_step, fetch)
+        if decision is None or not decision["switched"]:
+            return
+        idx = self._ctrl.index
+        self.exchanger = self._exchanger_for(idx)
+        self._step_fn = self._step_cache.get(idx)
+        self._raw_step_fn = self._raw_step_cache.get(idx)
 
     def _build(self, has_residuals: bool):
         telemetry = bool(self.cfg.telemetry)
@@ -390,14 +474,36 @@ class Trainer:
     def step(self, state: TrainState, batch, key: jax.Array):
         """One synchronous DP step. batch's leading dim is the global batch,
         split over the data axis."""
+        if self._ctrl is not None:
+            if self._host_step is None:
+                self._host_step = int(state.step)
+            if (
+                self._host_step > 0
+                and self._host_step % self.cfg.telemetry_every == 0
+                and self._telemetry_acc is not None
+            ):
+                self._control_update()
         if self._step_fn is None:
             with spans.span("train/build"):
                 self._step_fn = self._build(state.residuals is not None)
+            if self._ctrl is not None:
+                self._step_cache[self._ctrl.index] = self._step_fn
+                self._raw_step_cache[self._ctrl.index] = self._raw_step_fn
         state_nores = dataclasses.replace(state, residuals=None)
         if self.cfg.telemetry:
             if self._telemetry_acc is None:
-                self._telemetry_acc = MetricAccumulators.zeros(
-                    num_buckets=self.exchanger.num_buckets
+                # commit the fresh zeros to the replicated sharding the
+                # jitted step emits — an uncommitted accumulator would make
+                # jit specialize twice (one executable for the first step,
+                # another for the rest), breaking the one-executable-per-
+                # ladder-rung accounting the controller tests pin
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._telemetry_acc = jax.device_put(
+                    MetricAccumulators.zeros(
+                        num_buckets=self.exchanger.num_buckets
+                    ),
+                    NamedSharding(self.mesh, PartitionSpec()),
                 )
             new_nores, new_res, loss, wire, self._telemetry_acc = self._step_fn(
                 state_nores, state.residuals, batch, key, self._telemetry_acc
@@ -406,6 +512,8 @@ class Trainer:
             new_nores, new_res, loss, wire = self._step_fn(
                 state_nores, state.residuals, batch, key
             )
+        if self._ctrl is not None:
+            self._host_step += 1
         return dataclasses.replace(new_nores, residuals=new_res), loss, wire
 
     @property
@@ -416,7 +524,67 @@ class Trainer:
 
     def telemetry_summary(self) -> dict:
         """Fetch the accumulators to host (the telemetry_every sync point);
-        {} when telemetry is off or no step has run."""
+        {} when telemetry is off or no step has run. Alongside the
+        cumulative rows, `window_*` keys carry the same rates over the
+        span since the previous call (the controller's view)."""
         if self._telemetry_acc is None:
             return {}
-        return self._telemetry_acc.summary()
+        from deepreduce_tpu.telemetry.device_metrics import fetch_delta
+
+        acc = self._telemetry_acc
+        vals = acc.fetch()
+        out = acc.derive(vals)
+        # first call: no baseline yet, so the window IS the cumulative run
+        window_src = (
+            vals
+            if self._prev_summary_fetch is None
+            else fetch_delta(vals, self._prev_summary_fetch)
+        )
+        out.update({f"window_{k}": v for k, v in acc.derive(window_src).items()})
+        self._prev_summary_fetch = vals
+        return out
+
+    # ------------------------------------------------------------------ #
+    # adaptive controller surface (cfg.ctrl)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def controller(self):
+        """The live CompressionController (None when cfg.ctrl is off)."""
+        return self._ctrl
+
+    @property
+    def visited_ladder_indices(self) -> Tuple[int, ...]:
+        """Ladder rungs a step program was actually compiled for — the
+        bounded-re-jit witness (== distinct compiled step executables)."""
+        return tuple(sorted(self._step_cache))
+
+    def attach_decision_log(self, path) -> None:
+        """Persist every controller decision to `path` (decisions.jsonl)."""
+        if self._ctrl is None:
+            raise ValueError("attach_decision_log requires cfg.ctrl=True")
+        from deepreduce_tpu.controller import DecisionLog
+
+        self._ctrl.log = DecisionLog(path)
+
+    def controller_state(self) -> dict:
+        """Controller state pytree for checkpoint stamping (call after
+        init_state so the bucket geometry is known)."""
+        if self._ctrl is None:
+            raise ValueError("controller_state requires cfg.ctrl=True")
+        if self.exchanger is None:
+            raise ValueError("controller_state requires init_state() first")
+        return self._ctrl.state_dict(self.exchanger.num_buckets)
+
+    def load_controller_state(self, state: dict) -> None:
+        """Restore a checkpointed controller trajectory: the next decision
+        continues bitwise from the restored window baseline and vote
+        streaks (enforced by `make ctrl-check`)."""
+        if self._ctrl is None:
+            raise ValueError("load_controller_state requires cfg.ctrl=True")
+        self._ctrl.load_state_dict(state)
+        idx = self._ctrl.index
+        self.exchanger = self._exchanger_for(idx)
+        self._step_fn = self._step_cache.get(idx)
+        self._raw_step_fn = self._raw_step_cache.get(idx)
+        self._host_step = None  # re-sync from state.step at the next step()

@@ -1,0 +1,274 @@
+"""Tier-1 contract for the adaptive compression controller: the ladder
+is a bounded set of operating points (one compiled executable per rung
+visited, never more), the control law is deterministic with hysteresis,
+its state round-trips bitwise through a checkpoint, and turning the
+controller OFF leaves every committed ANALYSIS.json trace hash unchanged
+(the controller is host-side only — zero traced residue)."""
+
+import json
+import pathlib
+
+import pytest
+
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.controller import (
+    CompressionController,
+    Ladder,
+    validate_decision,
+)
+from deepreduce_tpu.controller.controller import _zero_fetch
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+FIXED = dict(
+    deepreduce="index",
+    index="bloom",
+    compress_ratio=0.02,
+    fpr=0.01,
+    memory="residual",
+    min_compress_size=100,
+)
+
+
+def _ctrl_cfg(**overrides):
+    base = dict(
+        telemetry=True,
+        ctrl=True,
+        ctrl_ladder="0.01,0.02,0.05",
+        ctrl_hysteresis=2,
+        ctrl_target_err_cos=0.5,
+        ctrl_headroom=0.1,
+        **FIXED,
+    )
+    base.update(overrides)
+    return DeepReduceConfig(**base)
+
+
+class _Stream:
+    """Synthetic cumulative fetch stream: feed per-window RATES, get the
+    running cumulative snapshot `observe` expects."""
+
+    def __init__(self):
+        self.cum = _zero_fetch(0)
+        self.step = 0
+
+    def window(self, n=5, *, err_cos=0.5, saturated=0.0):
+        self.step += n
+        self.cum = dict(self.cum)
+        self.cum["steps"] += float(n)
+        self.cum["err_cos"] += err_cos * n
+        self.cum["saturated"] += saturated * n
+        self.cum["index_bits"] += 100.0 * n
+        self.cum["dense_bits"] += 1000.0 * n
+        return self.step, dict(self.cum)
+
+
+# ---------------------------------------------------------------------- #
+# ladder
+# ---------------------------------------------------------------------- #
+
+
+def test_ladder_parse_apply_and_nearest():
+    lad = Ladder.parse("0.01,0.02@0.05,0.05")
+    assert len(lad) == 3
+    assert lad[1].ratio == 0.02 and lad[1].fpr == 0.05
+    assert lad[0].fpr is None
+    # nearest rung, ties break to the cheaper side
+    assert lad.index_near(0.0005) == 0
+    assert lad.index_near(0.02) == 1
+    assert lad.index_near(0.9) == 2
+    cfg = DeepReduceConfig(**FIXED)
+    cfg1 = lad.apply(cfg, 1)
+    assert cfg1.compress_ratio == 0.02 and cfg1.fpr == 0.05
+    cfg0 = lad.apply(cfg, 0)
+    assert cfg0.compress_ratio == 0.01 and cfg0.fpr == cfg.fpr  # fpr untouched
+
+
+@pytest.mark.parametrize(
+    "spec",
+    ["0.02", "0.05,0.02", "0,0.02", "0.02,1.5", "0.01,0.02@2", "a,b"],
+    ids=["single", "decreasing", "zero", "over-one", "bad-fpr", "garbage"],
+)
+def test_ladder_rejects_bad_specs(spec):
+    with pytest.raises(ValueError):
+        Ladder.parse(spec)
+
+
+def test_config_rejects_engaged_ctrl_knobs_without_ctrl():
+    with pytest.raises(ValueError):
+        DeepReduceConfig(telemetry=True, ctrl_hysteresis=5, **FIXED)
+    with pytest.raises(ValueError):  # ctrl needs telemetry
+        DeepReduceConfig(ctrl=True, **FIXED)
+
+
+# ---------------------------------------------------------------------- #
+# the control law (host-side, no jax)
+# ---------------------------------------------------------------------- #
+
+
+def test_controller_hysteresis_and_bounds():
+    ctrl = CompressionController(_ctrl_cfg())
+    assert ctrl.index == 1  # nearest rung to compress_ratio=0.02
+    s = _Stream()
+
+    # one low-fidelity window: vote up, but hysteresis=2 holds
+    rec = ctrl.observe(*s.window(err_cos=0.2))
+    validate_decision(rec)
+    assert (rec["trigger"], rec["rationale"]) == ("err_cos_low", "hold_hysteresis")
+    # second consecutive low window: move up
+    rec = ctrl.observe(*s.window(err_cos=0.2))
+    assert rec["switched"] and rec["rationale"] == "move_up"
+    assert (rec["old_index"], rec["new_index"]) == (1, 2)
+    # two more at the top rung: the ladder is a hard bound
+    ctrl.observe(*s.window(err_cos=0.2))
+    rec = ctrl.observe(*s.window(err_cos=0.2))
+    assert rec["rationale"] == "hold_at_top" and not rec["switched"]
+
+    # an in-band window resets the streak...
+    ctrl.observe(*s.window(err_cos=0.95))  # headroom vote (down), streak 1
+    rec = ctrl.observe(*s.window(err_cos=0.55))  # in band
+    assert rec["rationale"] == "hold_in_band"
+    # ...so one more down-vote is NOT enough, two are
+    rec = ctrl.observe(*s.window(err_cos=0.95))
+    assert rec["rationale"] == "hold_hysteresis"
+    rec = ctrl.observe(*s.window(err_cos=0.95))
+    assert rec["rationale"] == "move_down"
+    assert (rec["old_index"], rec["new_index"]) == (2, 1)
+
+    for r in ctrl.decisions:
+        validate_decision(r)
+    assert ctrl.switches == 2
+    # the rung in effect during each window is the OLD one
+    assert ctrl.effective_ratio() == pytest.approx(
+        (0.02 * 10 + 0.05 * 30) / 40
+    )
+
+
+def test_controller_saturation_trigger_outranks_headroom():
+    cfg = _ctrl_cfg(ctrl_saturation_ceiling=0.5, ctrl_hysteresis=1)
+    ctrl = CompressionController(cfg)
+    s = _Stream()
+    # fidelity says DOWN, saturation says UP — saturation wins
+    rec = ctrl.observe(*s.window(err_cos=0.95, saturated=2.0))
+    assert rec["trigger"] == "saturation_high" and rec["rationale"] == "move_up"
+
+
+def test_controller_empty_window_is_a_noop():
+    ctrl = CompressionController(_ctrl_cfg())
+    s = _Stream()
+    step, fetch = s.window(err_cos=0.2)
+    assert ctrl.observe(step, fetch) is not None
+    # same cumulative snapshot again: zero steps elapsed, no decision
+    assert ctrl.observe(step, dict(fetch)) is None
+    assert ctrl.windows == 1
+
+
+def test_controller_state_roundtrip_replays_identically():
+    a = CompressionController(_ctrl_cfg())
+    b = CompressionController(_ctrl_cfg())
+    sa, sb = _Stream(), _Stream()
+    for err in (0.2, 0.2, 0.9):
+        a.observe(*sa.window(err_cos=err))
+        b.observe(*sb.window(err_cos=err))
+    restored = CompressionController(_ctrl_cfg())
+    restored.load_state_dict(b.state_dict())
+    # continue both from the same point: decisions must be byte-identical
+    tail_a, tail_r = [], []
+    for err in (0.9, 0.9, 0.55, 0.2):
+        tail_a.append(a.observe(*sa.window(err_cos=err)))
+        tail_r.append(restored.observe(*sb.window(err_cos=err)))
+    assert [json.dumps(r, sort_keys=True) for r in tail_a] == [
+        json.dumps(r, sort_keys=True) for r in tail_r
+    ]
+    assert restored.index == a.index and restored.switches == a.switches
+
+
+# ---------------------------------------------------------------------- #
+# 50 adaptive steps on the 8-way mesh: bounded re-jit, end to end
+# ---------------------------------------------------------------------- #
+
+
+def test_adaptive_run_bounded_rejit(tmp_path):
+    """The whole tentpole claim in one run: 50 adaptive steps compile
+    exactly one step executable per ladder rung VISITED — switching
+    operating points re-jits at most len(ladder) times, ever."""
+    from deepreduce_tpu.controller.__main__ import _build_cfg, _run_train
+
+    cfg = _build_cfg()
+    log = tmp_path / "decisions.jsonl"
+    losses, trainer, _ = _run_train(cfg, steps=50, num_workers=8, log_path=log)
+
+    assert all(l == l for l in losses)  # finite
+    visited = trainer.visited_ladder_indices
+    ladder = trainer.controller.ladder
+    # distinct compiled step executables == ladder points visited
+    assert len(trainer._step_cache) == len(visited)
+    assert 1 <= len(visited) <= len(ladder)
+    assert trainer.controller.switches >= 1  # it actually adapted
+    # each cached step function compiled exactly once (no silent retraces)
+    sizes = [
+        fn._cache_size()
+        for fn in trainer._step_cache.values()
+        if hasattr(fn, "_cache_size")
+    ]
+    if sizes:
+        assert sum(sizes) == len(visited), sizes
+    recs = [json.loads(l) for l in log.read_text().splitlines() if l.strip()]
+    assert recs and len(recs) == trainer.controller.windows
+    for r in recs:
+        validate_decision(r)
+    assert {r["new_index"] for r in recs} <= set(visited)
+
+
+# ---------------------------------------------------------------------- #
+# ctrl off == committed baseline: every ANALYSIS.json hash unchanged
+# ---------------------------------------------------------------------- #
+
+
+def _committed_hashes():
+    traces = json.load(open(REPO / "ANALYSIS.json"))["jaxpr_audit"]["traces"]
+    by_label = {}
+    for t in traces:
+        assert t["label"] not in by_label, f"duplicate label {t['label']}"
+        by_label[t["label"]] = t["jaxpr_hash"]
+    return by_label
+
+
+def test_full_audit_matches_committed_hashes():
+    """Every committed trace hash — the full pre-controller inventory —
+    reproduces bitwise with the controller code in the tree (ctrl=False
+    everywhere the audit traces the legacy configs).
+
+    Runs in a SUBPROCESS on purpose: jaxpr string hashes are stable only
+    within a fresh interpreter (jax name counters are per-process and the
+    committed baseline comes from `python -m deepreduce_tpu.analysis`,
+    which audits from a cold start); an in-process audit after other
+    tests have traced functions would diff on counter suffixes, not real
+    program changes."""
+    import subprocess
+    import sys
+
+    committed = _committed_hashes()
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import json\n"
+            "from deepreduce_tpu.analysis.jaxpr_audit import audit_all\n"
+            "records, _ = audit_all(quick=False)\n"
+            "print(json.dumps({r.label: r.jaxpr_hash for r in records"
+            " if not r.skipped}))\n",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    fresh = json.loads(out.stdout.strip().splitlines()[-1])
+    missing = sorted(set(committed) - set(fresh))
+    assert not missing, f"committed traces no longer audited: {missing}"
+    changed = sorted(
+        lbl for lbl, h in committed.items() if h and fresh[lbl] != h
+    )
+    assert not changed, f"committed trace hashes changed: {changed}"

@@ -164,6 +164,57 @@ def test_telemetry_accumulator_survives_across_steps():
     assert trainer.telemetry_summary()["steps"] == 3.0
 
 
+def test_summary_window_deltas_match_cumulative_diffs():
+    """The per-window delta is EXACTLY the difference of two consecutive
+    cumulative fetches — no separate windowed accumulator exists, so the
+    CLI's window rows can't drift from the cumulative ones."""
+    from deepreduce_tpu.telemetry.device_metrics import fetch_delta
+
+    cfg = DeepReduceConfig(**QSGD_CFG)
+    mesh = shared_mesh(8)
+    trainer = Trainer(TinyMLP(), cfg, optax.sgd(0.1), mesh)
+    x, y = _data()
+    batch = 64
+    state = trainer.init_state(jax.random.PRNGKey(0), (x[:batch], y[:batch]))
+    key = jax.random.PRNGKey(1)
+
+    def run(lo_step, n):
+        nonlocal state
+        for i in range(lo_step, lo_step + n):
+            lo = (i * batch) % (len(x) - batch)
+            state, _, _ = trainer.step(
+                state, (x[lo : lo + batch], y[lo : lo + batch]),
+                jax.random.fold_in(key, i),
+            )
+
+    run(0, 3)
+    f1 = trainer.telemetry.fetch()
+    run(3, 4)
+    f2 = trainer.telemetry.fetch()
+
+    delta = fetch_delta(f2, f1)
+    assert delta["steps"] == pytest.approx(4.0)
+    for k in MetricAccumulators.scalar_fields():
+        assert delta[k] == pytest.approx(f2[k] - f1[k], abs=1e-9), k
+    for a, b, d in zip(
+        f1["bucket_saturated"], f2["bucket_saturated"], delta["bucket_saturated"]
+    ):
+        assert d == pytest.approx(b - a, abs=1e-9)
+
+    # summary(prev=...) derives the window_* rows from exactly that delta
+    summ = trainer.telemetry.summary(prev=f1)
+    assert summ["window_steps"] == pytest.approx(4.0)
+    derived = MetricAccumulators.derive(delta)
+    for k, v in derived.items():
+        got = summ["window_" + k]
+        if isinstance(v, list):
+            assert got == pytest.approx(v)
+        else:
+            assert got == pytest.approx(v), k
+    # cumulative rows are untouched by the windowing
+    assert summ["steps"] == pytest.approx(7.0)
+
+
 # ---------------------------------------------------------------------- #
 # disabled == absent: byte-identical step program
 # ---------------------------------------------------------------------- #
@@ -295,6 +346,98 @@ def test_cli_trace_merges_spans_and_counters(tmp_path, capsys):
     assert cli.main(["trace", str(bare)]) == 0
     merged = json.loads(capsys.readouterr().out)
     assert all(e["ph"] == "C" for e in merged["traceEvents"])
+
+
+def test_cli_telemetry_off_notice(tmp_path, capsys):
+    """summary/trace on a telemetry-off run dir print a clean notice
+    instead of partial or KeyError-prone output, and still exit 0."""
+    off = _write_run(tmp_path, "off")  # no telemetry dict, no trace.json
+    assert cli.main(["summary", str(off)]) == 0
+    assert "telemetry: was off" in capsys.readouterr().out
+    assert cli.main(["summary", str(off), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep.get("telemetry_off") is True
+    # a run WITH device accumulators gets no notice and no flag
+    on = _write_run(tmp_path, "on", telemetry={"steps": 5.0})
+    assert cli.main(["summary", str(on)]) == 0
+    assert "was off" not in capsys.readouterr().out
+    assert cli.main(["summary", str(on), "--json"]) == 0
+    assert "telemetry_off" not in json.loads(capsys.readouterr().out)
+    # trace on a run with neither trace.json nor metrics: notice, exit 0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    (empty / "config.json").write_text(
+        json.dumps({"name": "empty", "tags": [], "config": {}})
+    )
+    assert cli.main(["trace", str(empty)]) == 0
+    assert "telemetry was off" in capsys.readouterr().out
+
+
+def _write_decisions(run, decs):
+    with open(run / "decisions.jsonl", "w") as f:
+        for d in decs:
+            f.write(json.dumps(d, sort_keys=True) + "\n")
+
+
+def _decision(step, *, switched, old_index, new_index, old_ratio, new_ratio,
+              trigger, rationale, window_steps=5):
+    return dict(
+        step=step, window_steps=window_steps, trigger=trigger,
+        rationale=rationale, switched=switched, old_index=old_index,
+        new_index=new_index, old_ratio=old_ratio, new_ratio=new_ratio,
+        old_fpr=None, new_fpr=None, err_cos=0.5, saturated_per_step=0.0,
+        rel_volume=old_ratio,
+    )
+
+
+def test_cli_ctrl_summary_trace_compare(tmp_path, capsys):
+    """The controller's decision trail surfaces in all three subcommands:
+    summary rows, Perfetto counter/instant events, and the adaptive-vs-
+    fixed matched-loss wire comparison."""
+    adaptive = _write_run(tmp_path, "adaptive", n=12,
+                          telemetry={"steps": 12.0})
+    # cheaper rung after the switch at step 5: rel_volume 0.08 -> 0.03
+    with open(adaptive / "metrics.jsonl", "w") as f:
+        for i in range(12):
+            f.write(json.dumps(
+                {"step": i, "ts": 1000.0 + i * 0.1, "loss": 2.0 - 0.1 * i,
+                 "rel_volume": 0.08 if i < 6 else 0.03}) + "\n")
+    _write_decisions(adaptive, [
+        _decision(5, switched=True, old_index=2, new_index=1,
+                  old_ratio=0.08, new_ratio=0.03,
+                  trigger="err_cos_headroom", rationale="move_down"),
+        _decision(10, switched=False, old_index=1, new_index=1,
+                  old_ratio=0.03, new_ratio=0.03,
+                  trigger="in_band", rationale="hold_in_band"),
+    ])
+
+    assert cli.main(["summary", str(adaptive)]) == 0
+    out = capsys.readouterr().out
+    assert "ctrl_switches_per_step" in out and "effective_ratio" in out
+    assert cli.main(["summary", str(adaptive), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ctrl"]["switches"] == 1
+    assert rep["ctrl"]["final_index"] == 1
+    assert rep["ctrl"]["effective_ratio"] == pytest.approx(
+        (5 * 0.08 + 5 * 0.03) / 10
+    )
+
+    out_f = tmp_path / "ctrl_trace.json"
+    assert cli.main(["trace", str(adaptive), "--out", str(out_f)]) == 0
+    ev = json.loads(out_f.read_text())["traceEvents"]
+    names = {e["name"] for e in ev}
+    assert "ctrl_ladder_index" in names and "ctrl_ratio" in names
+    assert any(e["ph"] == "i" and "ctrl switch" in e["name"] for e in ev)
+
+    # fixed baseline: same loss trajectory at flat rel_volume 0.08 — the
+    # adaptive run reaches the matched loss on strictly less wire
+    fixed = _write_run(tmp_path, "fixed", n=12)
+    capsys.readouterr()
+    assert cli.main(["compare", str(adaptive), str(fixed), "--ctrl"]) == 0
+    assert "less wire" in capsys.readouterr().out
+    # flipped roles: the expensive run is flagged
+    assert cli.main(["compare", str(fixed), str(adaptive), "--ctrl"]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
 
 
 # ---------------------------------------------------------------------- #

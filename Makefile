@@ -1,4 +1,4 @@
-.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check fedsim-check ctrl-check
+.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check fedsim-check ctrl-check overlap-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
@@ -6,7 +6,7 @@
 # telemetry round trip (telemetry-check), the resilience smoke
 # (chaos-check) and the federated round smoke (fedsim-check) so none of
 # those paths can rot while the gate stays green.
-analyze: telemetry-check chaos-check fedsim-check ctrl-check
+analyze: telemetry-check chaos-check fedsim-check ctrl-check overlap-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
 
 # adaptive-controller smoke: a short adaptive train on the 8-worker CPU
@@ -36,6 +36,36 @@ fedsim-check:
 # counters (python -m deepreduce_tpu.resilience check)
 chaos-check:
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.resilience --platform cpu check
+
+# streaming-exchange overlap gate: two short mlp trains on the 8-worker
+# CPU mesh with IDENTICAL seeds (batches are pure functions of
+# (seed, step)) — one with the backprop-streamed bucket exchange, one
+# with the barrier schedule (bucket_pipeline=False). The telemetry CLI
+# asserts the streaming run's exchange/bucket/* spans overlap
+# train/forward_backward (trace --overlap, threshold-gated exit code),
+# then the two metrics.jsonl loss/rel_volume series are compared
+# BITWISE: losses at steps >= 1 depend on the exchanged gradients, so
+# series equality proves streaming moved only the dispatch order.
+OVERLAP_CHECK_DIR := /tmp/drtpu_overlap_check
+OVERLAP_CHECK_CFG := 'compressor':'topk','compress_ratio':0.05,'deepreduce':'index','index':'bloom','fpr':0.01,'memory':'residual','bucket_bytes':8192
+overlap-check:
+	rm -rf $(OVERLAP_CHECK_DIR)
+	JAX_PLATFORMS=cpu python benchmarks/train.py --platform cpu \
+		--model mlp --num_steps 6 --batch_size 8 --num_workers 8 --seed 0 \
+		--telemetry --track_dir $(OVERLAP_CHECK_DIR) --run_name stream \
+		--log_every 0 \
+		--grace_config "{$(OVERLAP_CHECK_CFG),'stream_exchange':True}"
+	JAX_PLATFORMS=cpu python benchmarks/train.py --platform cpu \
+		--model mlp --num_steps 6 --batch_size 8 --num_workers 8 --seed 0 \
+		--telemetry --track_dir $(OVERLAP_CHECK_DIR) --run_name barrier \
+		--log_every 0 \
+		--grace_config "{$(OVERLAP_CHECK_CFG),'bucket_pipeline':False}"
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry trace \
+		$(OVERLAP_CHECK_DIR)/stream --overlap
+	python -c "import json,sys; \
+		rd=lambda n:[(r['loss'],r['rel_volume']) for r in map(json.loads, open('$(OVERLAP_CHECK_DIR)/'+n+'/metrics.jsonl'))]; \
+		a,b=rd('stream'),rd('barrier'); \
+		sys.exit(0 if a==b and a else (print('overlap-check: metrics diverge',a,b),1)[1])"
 
 # end-to-end telemetry round trip on the CPU virtual mesh: a short
 # telemetry-on training run writes a tracked run dir (metrics + device

@@ -582,6 +582,64 @@ print(json.dumps({{
     return {}
 
 
+def _overlap_model(rec: dict, iters: int = 7) -> dict:
+    """Model the r15 streaming schedule (cfg.stream_exchange) against the
+    r09 pipelined bucket schedule on the 100 Mbps planning link, from one
+    measured flagship codec row at the leafy-LSTM size.
+
+    The streaming model is `costmodel.overlapped_step_time`: backward
+    compute hides allgather wire, leaving encode + exposed wire + decode.
+    The curve sweeps compute_time as fractions of the allgather time and
+    reports `costmodel.overlap_fraction` at each point; the committed
+    headline is the full-overlap regime (compute_time >= wire, the DDP
+    overlap premise the streaming schedule targets), where
+    t_enc + W*t_dec <= t_enc + max(wire, W*t_dec) — the r09 pipelined
+    model — holds unconditionally."""
+    cm = _costmodel()
+    shapes = lstm_leafy_shapes()
+    d = int(sum(shapes.values()))
+    ratio = 0.10
+    m = measure_config(
+        d, ratio,
+        dict(deepreduce="both", index="bloom", value="qsgd", policy="p0",
+             fpr=0.02, memory="none"),
+        iters,
+    )
+    W = int(rec.get("workers", 8) or 8)
+    wire = cm.allgather_time(m["payload_bytes"], W, cm.BW_100MBPS)
+    t_pipelined = cm.hier_dcn_time(
+        "bucketed", d, W, ratio, cm.BW_100MBPS, measurement=m
+    )
+    curve = []
+    for frac in (0.0, 0.25, 0.5, 1.0):
+        ct = frac * wire
+        curve.append(
+            {
+                "compute_time_s": round(ct, 4),
+                "t_streaming_s": round(
+                    cm.overlapped_step_time(m, W, compute_time=ct), 4
+                ),
+                "overlap_fraction": round(
+                    cm.overlap_fraction(m, W, compute_time=ct), 4
+                ),
+            }
+        )
+    t_stream = cm.overlapped_step_time(m, W, compute_time=wire)
+    return {
+        "d": d,
+        "workers": W,
+        "ratio": ratio,
+        "bw_bytes_per_s": cm.BW_100MBPS,
+        "measurement": {k: round(float(v), 6) for k, v in m.items()},
+        "t_allgather_s": round(wire, 4),
+        "t_serialized_s": round(cm.fused_step_time(m, W), 4),
+        "t_pipelined_r09_s": round(t_pipelined, 4),
+        "t_streaming_full_overlap_s": round(t_stream, 4),
+        "streaming_le_pipelined": bool(t_stream <= t_pipelined),
+        "curve": curve,
+    }
+
+
 def decode_strategy_sweep(d: int = LSTM_D, workers: int = 8) -> dict:
     """The fused-exchange decode-strategy sweep arm: the SAME flagship
     bloom+qsgd exchange measured under all three cfg.decode_strategy values
@@ -1174,6 +1232,9 @@ def main() -> None:
 
         force_platform("cpu")
         rec = _bucketed_subprocess(_bucket_bytes_arg())
+        # r15: price the streaming schedule against the r09 pipelined one
+        # on the same measured codec row (costmodel.overlapped_step_time)
+        overlap = _overlap_model(rec, iters=3 if "--quick" in sys.argv else 7)
         print(
             json.dumps(
                 {
@@ -1182,6 +1243,7 @@ def main() -> None:
                     "unit": "x",
                     "platform": "cpu",
                     "detail": rec,
+                    "overlap_model": overlap,
                 }
             )
         )

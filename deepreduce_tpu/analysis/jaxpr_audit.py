@@ -669,6 +669,70 @@ def audit_ctrl_ladder(*, d: int = 4096) -> List[TraceRecord]:
     return records
 
 
+def audit_streaming_exchange() -> List[TraceRecord]:
+    """The backprop-overlapped streaming schedule (cfg.stream_exchange):
+    trace one streamed grad+exchange step — comm_stream's custom_vjp
+    hooks dispatch each bucket's encode + all_gather from inside the
+    backward pass — over the bucketed census on the 8-way mesh.
+
+    The invariants are the BARRIER schedule's, unchanged: exactly
+    _BUCKET_COUNT all_gather eqns whose operand bytes sum to
+    payload_bytes() (the wire-accounting rule), _BUCKET_COUNT sparsifier
+    selections for 6 leaves, no callbacks, retrace-stable. Streaming
+    moves dispatch order only; if it ever grew an extra collective, a
+    re-encode, or changed a payload byte, this audit flags it."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepreduce_tpu.comm_stream import StreamingExchange
+
+    label = "exchange:streaming"
+    tmap = jax.tree_util.tree_map
+    mesh = audit_mesh()
+    cfg = DeepReduceConfig(
+        memory="residual", decode_strategy="loop",
+        bucket_bytes=_BUCKET_BYTES, stream_exchange=True, **_FLAGSHIP
+    )
+    grads_like = {n: _sds((int(sz),)) for n, sz in _BUCKET_LEAVES.items()}
+    ex = GradientExchanger(
+        grads_like, cfg, axis_name=AXIS, num_workers=NUM_WORKERS
+    )
+    stream = StreamingExchange(ex)
+    pb = ex.payload_bytes(grads_like)
+    g_w = tmap(lambda s: _sds((NUM_WORKERS,) + s.shape), grads_like)
+
+    def loss_fn(params, batch_stats, batch):
+        # linear-in-params probe: the cotangent of each leaf is its batch
+        # row, so the streamed hooks see ordinary per-worker gradients
+        loss = sum(
+            jnp.sum(p * batch[n]) for n, p in params.items()
+        )
+        return loss, batch_stats
+
+    def spmd(p, b_w, res, step):
+        b0 = tmap(lambda x: x[0], b_w)
+        res0 = tmap(lambda r: r[0], res)
+        _, _, agg, new_res, _ = stream.value_and_grad_exchange(
+            loss_fn, p, {}, b0, res0, step=step
+        )
+        new_res = tmap(lambda r: r[None], new_res)
+        return tmap(lambda x: x[None], agg), new_res
+
+    fn = _shard_map(
+        spmd, mesh, (P(), P(AXIS), P(AXIS), P()), (P(AXIS), P(AXIS))
+    )
+    args = (grads_like, g_w, g_w, _STEP)
+    ctx = AuditContext(
+        label=label,
+        allow_callbacks=False,
+        expect_collectives={"all_gather": _BUCKET_COUNT},
+        wire_mode="allgather",
+        expected_wire_bytes=pb,
+        num_workers=NUM_WORKERS,
+        expect_codec_invocations=_BUCKET_COUNT,
+    )
+    return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
+
+
 # ---------------------------------------------------------------------- #
 # the audited configuration inventory
 # ---------------------------------------------------------------------- #
@@ -1053,6 +1117,10 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
     # distinct hashes, zero traced residue (registered last so the
     # pre-existing record order — and ANALYSIS.json hashes — are stable) ---
     add("ctrl:ladder", lambda: audit_ctrl_ladder())
+    # --- the streaming schedule: bucketed invariants unchanged with every
+    # dispatch moved into the backward pass (registered last so the
+    # pre-existing record order — and ANALYSIS.json hashes — are stable) ---
+    add("exchange:streaming", lambda: audit_streaming_exchange())
     return specs
 
 

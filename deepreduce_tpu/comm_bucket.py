@@ -24,6 +24,13 @@ This module trades both costs down:
   collective for bucket b+1 is dispatched BEFORE the decode of bucket b
   (the SparCML streaming shape), so XLA can overlap the next transfer
   with the current decode.
+* A third schedule streams each bucket out of the BACKWARD pass itself
+  (`run_streaming_bucket`, driven by comm_stream.py's custom_vjp hooks
+  when ``cfg.stream_exchange`` is on): the encode + all_gather dispatch
+  the moment backprop produces the bucket's last member gradient, pinned
+  in dispatch order by an `optimization_barrier` token chain. Pair it
+  with ``partition_buckets(order="reverse")`` so buckets fill in
+  backward-completion order.
 
 Slicing a bucket's aggregate back into leaf shapes is static offsets
 (`split_bucket`), so residual error-feedback, WireStats accounting, and
@@ -70,20 +77,36 @@ class BucketSpec:
 
 
 def partition_buckets(
-    names: Sequence[str], sizes: Sequence[int], bucket_bytes: int
+    names: Sequence[str],
+    sizes: Sequence[int],
+    bucket_bytes: int,
+    *,
+    order: str = "trace",
 ) -> List[BucketSpec]:
     """Deterministic size-balanced partition computed from (name, size)
     pairs alone — every worker derives the identical bucket list from the
     gradient shapes with no coordination.
 
     Leaves whose dense f32 payload exceeds ``bucket_bytes`` become solo
-    buckets. The remaining small leaves are packed first-fit-decreasing
-    (ties broken by original leaf order) into fused buckets of at most
-    ``bucket_bytes``; a fused bucket that ends up holding a single leaf is
-    demoted to solo so it keeps the leaf's name. Within a fused bucket the
-    leaves are concatenated in original pytree order, and the bucket list
-    itself is ordered by each bucket's earliest member leaf.
+    buckets. With ``order="trace"`` (the default, byte-identical to the
+    r09 behavior) the remaining small leaves are packed
+    first-fit-decreasing (ties broken by original leaf order) into fused
+    buckets of at most ``bucket_bytes``, and the bucket list is ordered by
+    each bucket's earliest member leaf.
+
+    ``order="reverse"`` is the backward-completion policy the streaming
+    schedule wants: small leaves are packed next-fit walking the leaf
+    indices in DESCENDING order, so each fused bucket holds a contiguous
+    reverse-trace run — backprop, which produces gradients in reverse
+    forward order, finishes an entire bucket before touching the next.
+    The bucket list is sorted by descending earliest member (= ascending
+    backward completion time), so bucket 0 is the first one backprop can
+    close. Within a fused bucket the leaves are still concatenated in
+    original pytree order, keeping `split_bucket` offsets and the codec
+    slot budget independent of the policy.
     """
+    if order not in ("trace", "reverse"):
+        raise ValueError(f"order must be 'trace' or 'reverse', got {order!r}")
     if len(names) != len(sizes):
         raise ValueError("names and sizes must align")
     if len(set(names)) != len(names):
@@ -110,21 +133,37 @@ def partition_buckets(
             _solo(i) if int(size) > cap else i
         )
 
-    # First-fit-decreasing over the small leaves: visit by descending
-    # size (original order breaks ties), drop each into the first bin
-    # with room. Deterministic, and within ~22% of the optimal bin count.
     bins: List[List[int]] = []
     loads: List[int] = []
-    for i in sorted(small, key=lambda i: (-int(sizes[i]), i)):
-        size = int(sizes[i])
-        for b, load in enumerate(loads):
-            if load + size <= cap:
-                bins[b].append(i)
-                loads[b] += size
-                break
-        else:
-            bins.append([i])
-            loads.append(size)
+    if order == "reverse":
+        # Next-fit over DESCENDING leaf index: the current bin takes
+        # consecutive reverse-trace leaves until one no longer fits, then a
+        # fresh bin opens. Strict contiguity costs some packing density vs
+        # FFD, but it is exactly what makes a streaming bucket close as a
+        # single uninterrupted stretch of the backward pass.
+        for i in sorted(small, reverse=True):
+            size = int(sizes[i])
+            if bins and loads[-1] + size <= cap:
+                bins[-1].append(i)
+                loads[-1] += size
+            else:
+                bins.append([i])
+                loads.append(size)
+    else:
+        # First-fit-decreasing over the small leaves: visit by descending
+        # size (original order breaks ties), drop each into the first bin
+        # with room. Deterministic, and within ~22% of the optimal bin
+        # count.
+        for i in sorted(small, key=lambda i: (-int(sizes[i]), i)):
+            size = int(sizes[i])
+            for b, load in enumerate(loads):
+                if load + size <= cap:
+                    bins[b].append(i)
+                    loads[b] += size
+                    break
+            else:
+                bins.append([i])
+                loads.append(size)
 
     fused_count = 0
     for members in bins:
@@ -151,7 +190,14 @@ def partition_buckets(
             )
         )
 
-    specs.sort(key=lambda s: min(index[n] for n in s.names))
+    if order == "reverse":
+        # Backward-completion order: backprop emits gradients from the last
+        # leaf down to the first, so a bucket is complete once its
+        # EARLIEST-forward member arrives — the bucket with the largest
+        # min-index closes first.
+        specs.sort(key=lambda s: -min(index[n] for n in s.names))
+    else:
+        specs.sort(key=lambda s: min(index[n] for n in s.names))
     return specs
 
 
@@ -178,7 +224,9 @@ class BucketedExchanger:
         }
         sizes = [_numel(self.leaf_shapes[n]) for n in names]
         self.specs: Tuple[BucketSpec, ...] = tuple(
-            partition_buckets(list(names), sizes, cfg.bucket_bytes)
+            partition_buckets(
+                list(names), sizes, cfg.bucket_bytes, order=cfg.bucket_order
+            )
         )
         # per-bucket operating points from the adaptive controller's ladder:
         # a (ratio, fpr-or-None) pair per bucket, in spec order, overriding
@@ -400,6 +448,56 @@ class BucketedExchanger:
             if need_own:
                 own_leaves.update(self.split_bucket(spec, owns[b]))
         return agg_leaves, own_leaves, stats_per, payloads
+
+    def run_streaming_bucket(
+        self,
+        b: int,
+        flat_grads,
+        num_workers,
+        step,
+        worker_key,
+        *,
+        need_own: bool,
+        token,
+    ):
+        """One bucket of the STREAMING schedule (comm_stream.py): the same
+        encode → pack → all_gather → decode a barrier/pipeline bucket runs,
+        but dispatched from inside a custom_vjp backward rule the moment the
+        bucket's last member gradient exists. `token` is the f32 scalar
+        dispatch token threaded bucket-to-bucket: the incoming token pins
+        this bucket's encode AFTER the previous bucket's gather dispatch,
+        and the returned token is pinned to this bucket's gathered buffer —
+        `lax.optimization_barrier` is value-identity, so the pinning moves
+        only the schedule, never the numbers.
+
+        Returns ``(total, own, stats, payload, token)`` — the pre-division
+        decode sum over workers, this worker's own decode (None unless
+        ``need_own``), the bucket's WireStats, its payload (for fp_stats),
+        and the chained token.
+        """
+        if self._chaos is not None or self._checksum:
+            raise ValueError(
+                "streaming schedule does not thread chaos/checksum state "
+                "(config validation rejects stream_exchange with resilience)"
+            )
+        spec = self.specs[b]
+        codec = self.codecs[spec.label]
+        with spans.span(f"exchange/bucket/{spec.label}"):
+            dense = self.concat_bucket(flat_grads, spec)
+            dense, token = jax.lax.optimization_barrier((dense, token))
+            with spans.span("exchange/encode"):
+                key = per_tensor_key(worker_key, spec.label, step)
+                payload = codec.encode(dense, step=step, key=key)
+                stats = codec.wire_stats(payload)
+            with spans.span("exchange/pack"):
+                buf = self.layouts[spec.label].pack(payload)
+            with spans.span("exchange/allgather"):
+                gathered = jax.lax.all_gather(buf, self.axis_name)
+            gathered, token = jax.lax.optimization_barrier((gathered, token))
+            total, own, _fails = self._decode_bucket(
+                spec, gathered, num_workers, step, need_own=need_own
+            )
+        return total, own, stats, payload, token
 
     def saturation_vector(self, stats_per: Dict[str, WireStats]) -> jax.Array:
         """f32[C] per-bucket saturation flags in spec order — the telemetry

@@ -1,0 +1,258 @@
+"""Backprop-overlapped streaming bucket exchange (``cfg.stream_exchange``).
+
+The barrier and pipeline schedules (comm_bucket.run) both wait for the
+full ``value_and_grad`` pytree before the first encode. This module moves
+each bucket's encode + all_gather INTO the backward pass: the loss is
+wrapped so every bucket's member parameters flow through an identity
+``jax.custom_vjp`` hook, and the hook's backward rule — which reverse-mode
+AD executes at the exact point where the bucket's last member cotangent
+exists — runs that bucket's compensate → encode → pack → all_gather →
+decode (`BucketedExchanger.run_streaming_bucket`). Wire time hides behind
+the backward compute still running for earlier layers, the per-tensor-hook
+design DeepReduce inherited from Horovod done natively in XLA.
+
+Mechanics worth knowing before editing:
+
+* **Hook placement.** Reverse-mode AD runs each equation's transpose at
+  the mirrored position of its forward occurrence, so the hooks are
+  applied to the params in REVERSED bucket order during the forward pass —
+  their backward rules then fire in bucket order 0..C-1, which under
+  ``bucket_order="reverse"`` is backward-completion order.
+* **Dispatch pinning.** A scalar f32 token threads hook-to-hook. Inside
+  each backward rule the incoming token is `optimization_barrier`-tied to
+  the bucket's dense gradient before encode, and the outgoing token to its
+  gathered buffer — so bucket b+1's encode cannot be hoisted above bucket
+  b's gather dispatch, while the barrier (a value identity) leaves every
+  number untouched.
+* **Bitwise contract.** Same partition, same codecs, same
+  ``per_tensor_key(worker_key, label, step)`` PRNG keys, same pack/gather/
+  decode arithmetic, same ``total / num_workers`` mean and dtype casts as
+  `GradientExchanger.exchange` over `BucketedExchanger.run` — the
+  streaming step's params/residuals/telemetry are bit-identical to the
+  ``bucket_pipeline`` schedules (tests/test_streaming.py pins this); only
+  the dispatch order moves.
+* **Residual feedback as cotangents.** The hook takes the bucket's
+  residual leaves as a differentiated argument; its backward rule returns
+  the aggregated mean as the PARAM cotangent and the updated residual
+  (compensated − own decode) as the RESIDUAL cotangent, so one
+  ``jax.value_and_grad(..., argnums=(0, 1))`` yields both trees with no
+  second pass.
+* **Trace-time side channel.** Backward rules execute while the grad call
+  is being traced, so per-bucket WireStats, payloads (for fp_stats), and
+  the raw incoming cotangents (the un-compensated gradients telemetry
+  needs) are stashed in host-side dicts and consumed right after the grad
+  call returns — same trace, no host sync.
+* **`step`/`worker_key` ride as hook arguments** (custom_vjp rejects
+  closed-over tracers); being integer-dtype primals their cotangents are
+  ``float0`` zeros.
+
+What does NOT compose (rejected loudly in config.__post_init__):
+resilience (mask/chaos/checksum state has no per-hook threading), hier
+(its two-leg slice schedule owns the whole pytree), fed. A flat streaming
+exchange over a multi-axis mesh via a tuple ``axis_name`` works and is
+covered by tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepreduce_tpu.metrics import combine
+
+
+def _float0_zeros(x):
+    """The cotangent for an integer-dtype primal: float0 zeros of its shape."""
+    return np.zeros(np.shape(x), jax.dtypes.float0)
+
+
+class StreamingExchange:
+    """Streams a GradientExchanger's bucketed exchange out of the backward
+    pass. Built once per compiled step (Trainer rung) from an exchanger
+    that already has a `BucketedExchanger`; `value_and_grad_exchange` is
+    the streaming replacement for value_and_grad + `exchanger.exchange`.
+    """
+
+    def __init__(self, exchanger):
+        if exchanger._bucketed is None:
+            raise ValueError(
+                "StreamingExchange needs the bucketed exchange — construct "
+                "the GradientExchanger with cfg.bucket_bytes set"
+            )
+        self.exchanger = exchanger
+        self.bucketed = exchanger._bucketed
+        self.cfg = exchanger.cfg
+        self.axis_name = exchanger.axis_name
+        self.names = list(exchanger.names)
+        self._pos = {n: i for i, n in enumerate(self.names)}
+
+    def value_and_grad_exchange(
+        self,
+        loss_fn: Callable,
+        params: Any,
+        batch_stats: Any,
+        batch: Any,
+        residuals: Any,
+        *,
+        step,
+        key=None,
+        collect: Optional[Dict[str, jax.Array]] = None,
+    ):
+        """One streamed forward+backward+exchange.
+
+        Returns ``((loss, aux), grads, agg, new_residuals, wire)``:
+        worker-local loss and aux exactly as ``value_and_grad(loss_fn,
+        has_aux=True)`` would, the RAW per-worker gradients (for telemetry
+        parity with the unstreamed step), the aggregated mean gradients in
+        the runtime grad dtype, the updated residual tree (None when
+        ``residuals`` is None), and the combined WireStats. ``collect``
+        receives the same fp_count / fp_universe / bucket_saturated
+        telemetry scalars `GradientExchanger.exchange` would produce.
+        """
+        cfg = self.cfg
+        bucketed = self.bucketed
+        specs = bucketed.specs
+        has_res = residuals is not None
+        widx = jax.lax.axis_index(self.axis_name)
+        if key is None:
+            key = jax.random.PRNGKey(cfg.seed)
+        worker_key = jax.random.fold_in(key, widx)
+
+        # trace-time side channel: the hooks' backward rules populate these
+        # while the grad call below is being traced
+        stash: Dict[str, Dict[str, Any]] = {"stats": {}, "payloads": {}, "raw": {}}
+        hooks = [
+            self._make_hook(b, stash, need_own=has_res) for b in range(len(specs))
+        ]
+        leaves_like = jax.tree_util.tree_leaves(params)
+        if len(leaves_like) != len(self.names):
+            raise ValueError(
+                f"params tree has {len(leaves_like)} leaves but the "
+                f"exchanger was built for {len(self.names)}"
+            )
+
+        def hooked_loss(p, r):
+            leaves = list(jax.tree_util.tree_leaves(p))
+            res_leaves = jax.tree_util.tree_leaves(r) if has_res else None
+            tok = jnp.zeros((), jnp.float32)
+            # reversed bucket order here → backward rules fire in bucket
+            # order 0..C-1 during backprop (see module docstring)
+            for b in range(len(specs) - 1, -1, -1):
+                idxs = [self._pos[n] for n in specs[b].names]
+                sub = tuple(leaves[i] for i in idxs)
+                rsub = (
+                    tuple(res_leaves[i] for i in idxs) if has_res else ()
+                )
+                sub, tok = hooks[b](sub, rsub, step, worker_key, tok)
+                for j, i in enumerate(idxs):
+                    leaves[i] = sub[j]
+            p_hooked = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(p), leaves
+            )
+            return loss_fn(p_hooked, batch_stats, batch)
+
+        if has_res:
+            (loss, aux), (agg_tree, new_res) = jax.value_and_grad(
+                hooked_loss, argnums=(0, 1), has_aux=True
+            )(params, residuals)
+        else:
+            (loss, aux), agg_tree = jax.value_and_grad(
+                hooked_loss, has_aux=True
+            )(params, None)
+            new_res = None
+
+        # spec-order dicts so combine()'s summation order — and therefore
+        # the f32 wire totals — match the barrier/pipeline encode loop
+        stats_per = {s.label: stash["stats"][s.label] for s in specs}
+        payloads = {s.label: stash["payloads"][s.label] for s in specs}
+        raw_leaves = {}
+        for spec in specs:
+            raw_leaves.update(dict(zip(spec.names, stash["raw"][spec.label])))
+        grads = jax.tree_util.tree_unflatten(
+            self.exchanger.treedef, [raw_leaves[n] for n in self.names]
+        )
+
+        if collect is not None:
+            fp_c = jnp.zeros((), jnp.float32)
+            fp_u = jnp.zeros((), jnp.float32)
+            for label, codec in bucketed.codecs.items():
+                stats = codec.fp_stats(payloads[label])
+                if stats is None:
+                    continue
+                fp_c = fp_c + stats[0]
+                fp_u = fp_u + stats[1]
+            collect["fp_count"] = fp_c
+            collect["fp_universe"] = fp_u
+            collect["bucket_saturated"] = bucketed.saturation_vector(stats_per)
+
+        return (loss, aux), grads, agg_tree, new_res, combine(stats_per)
+
+    def _make_hook(self, b: int, stash, *, need_own: bool):
+        """The identity custom_vjp hook for bucket `b`. Forward passes the
+        bucket's param leaves (and the dispatch token) through unchanged;
+        backward runs the bucket's whole streamed exchange and returns the
+        aggregated mean as the param cotangent, the updated residual as the
+        residual cotangent, and the chained token."""
+        bucketed = self.bucketed
+        spec = bucketed.specs[b]
+        cfg = self.cfg
+        axis = self.axis_name
+
+        @jax.custom_vjp
+        def hook(p_leaves, r_leaves, step, worker_key, token):
+            return p_leaves, token
+
+        def fwd(p_leaves, r_leaves, step, worker_key, token):
+            return (p_leaves, token), (r_leaves, step, worker_key)
+
+        def bwd(saved, cts):
+            r_leaves, step, worker_key = saved
+            g_leaves, token = cts
+            num_workers = jax.lax.psum(1, axis)
+            # per-leaf memory.compensate (identical expression per leaf)
+            if need_own:
+                comp = tuple(
+                    cfg.beta * r + cfg.gamma * g
+                    for r, g in zip(r_leaves, g_leaves)
+                )
+            else:
+                comp = tuple(g_leaves)
+            flat = dict(zip(spec.names, comp))
+            total, own, stats, payload, token = bucketed.run_streaming_bucket(
+                b,
+                flat,
+                num_workers,
+                step,
+                worker_key,
+                need_own=need_own,
+                token=token,
+            )
+            agg_slices = bucketed.split_bucket(spec, total / num_workers)
+            agg_ct = tuple(
+                agg_slices[n].astype(c.dtype) for n, c in zip(spec.names, comp)
+            )
+            if need_own:
+                own_slices = bucketed.split_bucket(spec, own)
+                # per-leaf memory.update: compensated − own decode, with the
+                # same dtype cast exchange() applies before the update
+                res_ct = tuple(
+                    c - own_slices[n].astype(c.dtype)
+                    for n, c in zip(spec.names, comp)
+                )
+            else:
+                res_ct = ()
+            stash["stats"][spec.label] = stats
+            stash["payloads"][spec.label] = payload
+            stash["raw"][spec.label] = tuple(g_leaves)
+            return (
+                agg_ct,
+                res_ct,
+                _float0_zeros(step),
+                _float0_zeros(worker_key),
+                token,
+            )
+
+        hook.defvjp(fwd, bwd)
+        return hook

@@ -145,6 +145,24 @@ class DeepReduceConfig:
     # transfer with the current decode (the SparCML streaming shape).
     # False = gather every bucket, then decode (barrier shape, for A/Bs).
     bucket_pipeline: bool = True
+    # bucket-list ordering policy (comm_bucket.partition_buckets):
+    #   'trace'   — buckets ordered by earliest member leaf in pytree
+    #               (forward-trace) order; the r09 default, byte-identical
+    #   'reverse' — backward-completion order: small leaves packed as
+    #               contiguous reverse-trace runs and the bucket list
+    #               sorted by when backprop produces each bucket's LAST
+    #               member gradient, so streaming buckets close as early
+    #               as possible. Deterministic from (name, size) alone.
+    bucket_order: str = "trace"  # trace | reverse
+    # backprop-overlapped streaming exchange (comm_stream.py): wrap the
+    # loss in per-bucket custom_vjp hooks so each bucket's encode +
+    # all_gather dispatches the moment backprop produces its last member
+    # gradient — interleaved with the remaining backward compute via an
+    # optimization_barrier-pinned token chain — instead of after the full
+    # value_and_grad. Bitwise identical to the bucket_pipeline schedule
+    # (same codecs, same PRNG keys, same wire bytes); only the dispatch
+    # order moves. Requires bucket_bytes.
+    stream_exchange: bool = False
     # small-tensor bypass (pytorch/deepreduce.py:68). None = the reference
     # default for the selected codec: 1000 (PyTorch generic gate), or 9000
     # when value='doubleexp' (tensorflow/deepreduce.py:396,426). An explicit
@@ -284,6 +302,7 @@ class DeepReduceConfig:
     RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "auto")
     HIER_ICI_LEGS = ("dense", "qar", "auto")
     HIER_DCN_MODES = ("config", "auto")
+    BUCKET_ORDERS = ("trace", "reverse")
 
     def __post_init__(self):
         def check(name, value, allowed):
@@ -345,6 +364,58 @@ class DeepReduceConfig:
             raise ValueError(
                 "bucket_bytes must be >= 4 (one f32 element) or None, got "
                 f"{self.bucket_bytes}"
+            )
+        check("bucket_order", self.bucket_order, self.BUCKET_ORDERS)
+        if self.bucket_order != "trace" and self.bucket_bytes is None:
+            raise ValueError(
+                f"bucket_order={self.bucket_order!r} orders the bucketed "
+                "exchange's partition and would be silently ignored with "
+                "bucket_bytes=None — set bucket_bytes (or drop bucket_order)"
+            )
+        # --- streaming exchange: loud failure for silently-ignored or
+        # --- structurally impossible combinations ---
+        if self.stream_exchange and self.bucket_bytes is None:
+            raise ValueError(
+                "stream_exchange=True streams the BUCKETED exchange out of "
+                "the backward pass (one custom_vjp hook per bucket) — with "
+                "bucket_bytes=None there is no bucket partition to stream. "
+                "Set bucket_bytes (or drop stream_exchange)"
+            )
+        if self.stream_exchange and self.resilience:
+            # The hooks fire per bucket DURING backprop, but the
+            # participation mask / chaos / checksum state is derived once
+            # per step and threaded through the single exchange call —
+            # there is no sound place to rebuild it inside a custom_vjp
+            # backward rule without replicating the mask derivation per
+            # bucket (and the checksum-failure counter is accumulated
+            # across buckets in one spot). Until the hooks learn to thread
+            # resilience state, the combination fails loudly here.
+            raise ValueError(
+                "stream_exchange=True dispatches each bucket from inside a "
+                "custom_vjp backward rule, which does not thread the "
+                "resilience subsystem's participation mask / chaos / "
+                "checksum state — run streaming without resilience, or the "
+                "barrier/pipeline schedules with it"
+            )
+        if self.stream_exchange and self.hier:
+            # Structurally impossible today: the hierarchical exchanger owns
+            # its own two-leg schedule (ICI psum then DCN exchange) built
+            # around the whole-pytree gradient; streaming would have to
+            # split BOTH legs per bucket and the ICI slice-mean psum per
+            # hook. A flat streaming exchange over a multi-axis mesh (tuple
+            # axis_name) works fine and is what the tests cover.
+            raise ValueError(
+                "stream_exchange=True streams the flat bucketed exchange "
+                "and cannot compose with hier=True's two-leg slice schedule "
+                "— use the flat exchange over the full mesh (a tuple "
+                "axis_name works), or hier without streaming"
+            )
+        if self.stream_exchange and self.fed:
+            raise ValueError(
+                "stream_exchange=True hooks the Trainer's per-step "
+                "value_and_grad; the federated round (fed=True) aggregates "
+                "client deltas through its own vmapped path and would "
+                "silently ignore it — drop one of the two"
             )
         # --- resilience surface: loud failure for silently-ignored knobs ---
         for rate_name in (

@@ -94,6 +94,41 @@ def fused_step_time(
     )
 
 
+def overlapped_step_time(
+    m: Dict[str, float],
+    W: int,
+    bw: float = BW_100MBPS,
+    compute_time: float = 0.0,
+) -> float:
+    """Step-time model of the backprop-overlapped streaming schedule
+    (``cfg.stream_exchange``): each bucket's allgather dispatches while
+    backward compute for earlier layers is still running, so up to
+    ``compute_time`` seconds of wire hide behind it and only the residual
+    exposed tail ``max(0, wire - compute_time)`` is charged serially —
+    encode and the W decodes still pay their serial cost. With
+    ``compute_time=0`` this is exactly `fused_step_time` (nothing to hide
+    behind), so the streamed model can never exceed the r09 pipelined
+    schedule's."""
+    wire = allgather_time(m["payload_bytes"], W, bw)
+    exposed = max(0.0, wire - max(0.0, compute_time))
+    return m["t_encode_s"] + exposed + W * m["t_decode_s"]
+
+
+def overlap_fraction(
+    m: Dict[str, float],
+    W: int,
+    bw: float = BW_100MBPS,
+    compute_time: float = 0.0,
+) -> float:
+    """Fraction of the allgather wire time hidden behind backward compute
+    under the streaming schedule — the modeled counterpart of the measured
+    `trace --overlap` report. 1.0 when there is no wire to expose."""
+    wire = allgather_time(m["payload_bytes"], W, bw)
+    if wire <= 0.0:
+        return 1.0
+    return min(wire, max(0.0, compute_time)) / wire
+
+
 # ---------------------------------------------------------------------------
 # Federated round-time model (fedsim): the paper's deployment setting is C
 # client uplinks per round into one parameter server behind a shared ingest
@@ -252,13 +287,18 @@ def rs_step_time(
     *,
     t_compute_s: float = 0.0,
     bw: float = BW_100MBPS,
+    compute_time: float = 0.0,
     **kw,
 ) -> float:
     """W-aware modeled step time of one in-collective route: ring wire time
-    of each collective it issues plus its (once-per-worker) compute."""
+    of each collective it issues plus its (once-per-worker) compute.
+    ``compute_time`` is backward-pass compute available to hide wire behind
+    (the streaming-overlap discipline); 0 keeps the historical serialized
+    model byte-for-byte."""
     wire = 0.0
     for prim, size in rs_wire_bytes(mode, d, W, ratio, **kw).items():
         wire += _RING_TIME[prim](size, W, bw)
+    wire = max(0.0, wire - max(0.0, compute_time))
     return wire + t_compute_s
 
 
@@ -280,12 +320,16 @@ def select_rs_mode(
     cols: int = 0,
     bw: float = BW_100MBPS,
     modes: Optional[tuple] = None,
+    compute_time: float = 0.0,
 ) -> str:
     """Resolve ``rs_mode="auto"`` at construction time: argmin of the
     wire-only W-aware model over the concrete routes. At the 100 Mbps
     default link the step is wire-dominated, so compute terms (which need
     per-platform measurement) are deliberately excluded — the selector is
-    deterministic from (d, W, ratio) and static config alone."""
+    deterministic from (d, W, ratio) and static config alone.
+    ``compute_time`` (hideable backward compute, see `overlapped_step_time`)
+    threads through to each candidate's `rs_step_time`; the default 0
+    keeps the historical selection."""
     candidates = modes or ("sparse", "adaptive", "quantized", "sketch")
     best, best_t = None, float("inf")
     for mode in candidates:
@@ -293,6 +337,7 @@ def select_rs_mode(
             mode, d, W, ratio,
             headroom=headroom, out_headroom=out_headroom,
             block=block, rows=rows, cols=cols, bw=bw,
+            compute_time=compute_time,
         )
         if t < best_t:
             best, best_t = mode, t
@@ -355,6 +400,7 @@ def hier_dcn_time(
     *,
     measurement: Optional[Dict[str, float]] = None,
     t_compute_s: float = 0.0,
+    compute_time: float = 0.0,
     **kw,
 ) -> float:
     """Modeled DCN-leg time with `n_slices` workers on the scarce link.
@@ -364,7 +410,11 @@ def hier_dcn_time(
     value+index convention rs_wire_bytes uses). "bucketed" overlaps
     decode under the next bucket's gather, so it pays max(wire, decode)
     instead of their sum; with no measured compute the two tie and the
-    planner's candidate order prefers plain "fused"."""
+    planner's candidate order prefers plain "fused". ``compute_time`` is
+    hideable backward compute (the streaming overlap, `overlapped_step_
+    time`): it shaves every leg's wire before the formulas above, so the
+    planner can price what streaming buys on the scarce link; 0 keeps the
+    historical model."""
     if leg in ("fused", "bucketed"):
         m = measurement or {
             "payload_bytes": 8.0 * max(1, int(d * ratio)),
@@ -372,11 +422,13 @@ def hier_dcn_time(
             "t_decode_s": 0.0,
         }
         wire = allgather_time(m["payload_bytes"], n_slices, bw_dcn)
+        wire = max(0.0, wire - max(0.0, compute_time))
         if leg == "bucketed":
             return m["t_encode_s"] + max(wire, n_slices * m["t_decode_s"])
         return m["t_encode_s"] + wire + n_slices * m["t_decode_s"]
     return rs_step_time(
-        leg, d, n_slices, ratio, t_compute_s=t_compute_s, bw=bw_dcn, **_rs_kw(kw)
+        leg, d, n_slices, ratio, t_compute_s=t_compute_s, bw=bw_dcn,
+        compute_time=compute_time, **_rs_kw(kw)
     )
 
 
@@ -393,12 +445,16 @@ def hier_step_time(
     ici_block: int = 512,
     measurement: Optional[Dict[str, float]] = None,
     t_compute_s: float = 0.0,
+    compute_time: float = 0.0,
     **kw,
 ) -> float:
-    """Modeled step time of one (ici, dcn) plan: serialized two-leg sum."""
+    """Modeled step time of one (ici, dcn) plan: serialized two-leg sum.
+    ``compute_time`` (hideable backward compute) applies to the DCN leg
+    only — the ICI leg runs after the slice mean and cannot stream."""
     return hier_ici_time(ici, d, per_slice, bw_ici, block=ici_block) + hier_dcn_time(
         dcn, d, n_slices, ratio, bw_dcn,
-        measurement=measurement, t_compute_s=t_compute_s, **kw,
+        measurement=measurement, t_compute_s=t_compute_s,
+        compute_time=compute_time, **kw,
     )
 
 
@@ -415,6 +471,7 @@ def select_hier_plan(
     dcn_legs: Optional[tuple] = None,
     measurements: Optional[Dict[str, Dict[str, float]]] = None,
     compute: Optional[Dict[str, float]] = None,
+    compute_time: float = 0.0,
     **kw,
 ) -> Dict:
     """Construction-time auto-planner: argmin of `hier_step_time` over
@@ -439,7 +496,8 @@ def select_hier_plan(
             t = hier_step_time(
                 ici, dcn, d, n_slices, per_slice, ratio,
                 bw_ici=bw_ici, bw_dcn=bw_dcn, ici_block=ici_block,
-                measurement=m, t_compute_s=tc, **kw,
+                measurement=m, t_compute_s=tc, compute_time=compute_time,
+                **kw,
             )
             table[f"{ici}+{dcn}"] = t
             if best is None or t < table[f"{best[0]}+{best[1]}"]:

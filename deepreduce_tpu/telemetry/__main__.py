@@ -26,6 +26,14 @@ offline consumer of tracking.py run directories.
                              ctrl_ladder_index/ctrl_ratio counter tracks and
                              instant markers at each operating-point switch.
                              Load the output in Perfetto.
+- ``trace RUN --overlap``    wall-clock overlap fraction between the
+                             train/forward_backward spans and the
+                             exchange/bucket/* dispatch spans — ~1 for a
+                             streaming run (cfg.stream_exchange), 0 for a
+                             barrier/pipeline run; exits 1 below
+                             ``--overlap-threshold`` (the CI gate that
+                             backprop-overlapped dispatch actually
+                             happened).
 
 Runs with telemetry off get a clean "telemetry was off" notice instead of
 partial output. RUN may be a run directory or a tracking root (latest run
@@ -433,12 +441,96 @@ def cmd_compare(args) -> int:
 # ---------------------------------------------------------------------- #
 
 
+def _x_intervals(events, *, name: str = "", prefix: str = ""):
+    """Sorted (start, end) µs intervals of the complete ("X") span events
+    matching an exact name or a name prefix."""
+    out = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        n = e.get("name", "")
+        if name and n != name:
+            continue
+        if prefix and not n.startswith(prefix):
+            continue
+        ts, dur = e.get("ts"), e.get("dur")
+        if isinstance(ts, (int, float)) and isinstance(dur, (int, float)):
+            out.append((float(ts), float(ts) + float(dur)))
+    out.sort()
+    return out
+
+
+def _trace_overlap(run: pathlib.Path, events, threshold: float) -> int:
+    """`trace RUN --overlap`: wall-clock overlap fraction between the
+    forward+backward spans and the per-bucket exchange dispatch spans.
+
+    Each `train/forward_backward` interval is one step; the
+    `exchange/bucket/*` spans starting in [step_i, step_{i+1}) belong to
+    step i, and the step's overlap fraction is the share of their total
+    duration that falls INSIDE the forward_backward interval. Streaming
+    runs (cfg.stream_exchange) dispatch every bucket from the backward
+    pass, so the fraction is ~1; barrier/pipeline runs dispatch from
+    `train/exchange` after backward completes, so it is 0 — which makes
+    the threshold a CI gate that the overlap structurally happened.
+    Exits 1 below `--overlap-threshold`, 2 when the run has no usable
+    span structure (no trace, no forward_backward span, no bucket spans).
+    """
+    fb = _x_intervals(events, name="train/forward_backward")
+    buckets = _x_intervals(events, prefix="exchange/bucket/")
+    if not fb:
+        return _fail(
+            f"run {run.name} has no train/forward_backward spans "
+            "(telemetry off, or trace.json missing)"
+        )
+    if not buckets:
+        return _fail(
+            f"run {run.name} has no exchange/bucket/* spans — overlap "
+            "needs the bucketed exchange (cfg.bucket_bytes)"
+        )
+    per_step = []
+    tot_dur = tot_in = 0.0
+    for i, (s, e) in enumerate(fb):
+        nxt = fb[i + 1][0] if i + 1 < len(fb) else float("inf")
+        mine = [(bs, be) for bs, be in buckets if s <= bs < nxt]
+        if not mine:
+            continue
+        dur = sum(be - bs for bs, be in mine)
+        inside = sum(
+            max(0.0, min(be, e) - max(bs, s)) for bs, be in mine
+        )
+        tot_dur += dur
+        tot_in += inside
+        per_step.append((i, len(mine), inside / dur if dur else 0.0))
+    if not per_step:
+        return _fail(
+            f"run {run.name}: no exchange/bucket/* span falls in any "
+            "forward_backward step window"
+        )
+    frac = tot_in / tot_dur if tot_dur else 0.0
+    print(f"overlap: run {run.name}")
+    print(
+        f"  forward_backward spans: {len(fb)}   "
+        f"exchange/bucket spans: {len(buckets)}"
+    )
+    for i, n, f in per_step:
+        print(f"  step {i}: {n} bucket dispatches, overlap fraction {f:.3f}")
+    flag = "ok" if frac >= threshold else "BELOW THRESHOLD"
+    print(
+        f"  overall: {tot_in:.1f}us of {tot_dur:.1f}us bucket-dispatch time "
+        f"inside forward_backward  (fraction {frac:.3f}, "
+        f"threshold {threshold:g})  {flag}"
+    )
+    return 0 if frac >= threshold else 1
+
+
 def cmd_trace(args) -> int:
     run = _resolve_run(args.run)
     if run is None:
         return _fail(f"no run directory under {args.run!r}")
     trace = _load_json(run / "trace.json")
     events = list(trace.get("traceEvents", []))
+    if args.overlap:
+        return _trace_overlap(run, events, args.overlap_threshold)
     hist = _history(run)
     # per-step metrics become counter tracks next to the span rows; their
     # wall clock is rebased so step 0 aligns with the trace origin
@@ -564,6 +656,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("trace", help="merged Chrome trace JSON (Perfetto)")
     p.add_argument("run")
     p.add_argument("--out", default="-", help="output path ('-' = stdout)")
+    p.add_argument("--overlap", action="store_true",
+                   help="report the wall-clock overlap fraction between "
+                        "train/forward_backward and exchange/bucket/* spans "
+                        "instead of exporting the trace; exits 1 below "
+                        "--overlap-threshold (the streaming-exchange CI gate)")
+    p.add_argument("--overlap-threshold", type=float, default=0.5,
+                   metavar="FRAC",
+                   help="minimum acceptable overlap fraction for --overlap "
+                        "(default 0.5; streaming runs sit at ~1, barrier "
+                        "runs at 0)")
     p.set_defaults(fn=cmd_trace)
 
     args = ap.parse_args(argv)

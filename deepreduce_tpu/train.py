@@ -91,12 +91,40 @@ def make_worker_step(
                 "participation masks need the static mesh size: construct "
                 "GradientExchanger(..., num_workers=mesh.shape[axis])"
             )
+    # Python-level gate like `resilient`: the streaming-off step traces the
+    # identical source path as before, so its jaxpr stays byte-identical.
+    # config.__post_init__ guarantees stream_exchange never combines with
+    # resilience, so the mask branch below is dead under streaming.
+    streaming = None
+    if cfg.stream_exchange:
+        from deepreduce_tpu.comm_stream import StreamingExchange
+
+        streaming = StreamingExchange(exchanger)
 
     def step_fn(state: TrainState, batch, key: jax.Array, acc=None):
-        with spans.span("train/forward_backward"):
-            (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, state.batch_stats, batch
-            )
+        collect = {} if telemetry else None
+        if streaming is not None:
+            # the whole exchange happens INSIDE this span: each bucket's
+            # encode+gather dispatches from the custom_vjp backward rules,
+            # so the exchange/bucket/* spans land within forward_backward
+            with spans.span("train/forward_backward"):
+                (loss, new_stats), grads, agg, new_residuals, wire = (
+                    streaming.value_and_grad_exchange(
+                        loss_fn,
+                        state.params,
+                        state.batch_stats,
+                        batch,
+                        state.residuals,
+                        step=state.step,
+                        key=key,
+                        collect=collect,
+                    )
+                )
+        else:
+            with spans.span("train/forward_backward"):
+                (loss, new_stats), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params, state.batch_stats, batch)
         loss = jax.lax.pmean(loss, axis)
         if new_stats:
             new_stats = jax.lax.pmean(new_stats, axis)
@@ -113,16 +141,16 @@ def make_worker_step(
                     drop_rate=cfg.drop_rate,
                     fault_plan=cfg.fault_plan,
                 )
-        collect = {} if telemetry else None
-        with spans.span("train/exchange"):
-            agg, new_residuals, wire = exchanger.exchange(
-                grads,
-                state.residuals,
-                step=state.step,
-                key=key,
-                collect=collect,
-                mask=mask,
-            )
+        if streaming is None:
+            with spans.span("train/exchange"):
+                agg, new_residuals, wire = exchanger.exchange(
+                    grads,
+                    state.residuals,
+                    step=state.step,
+                    key=key,
+                    collect=collect,
+                    mask=mask,
+                )
         with spans.span("train/apply_updates"):
             updates, new_opt = optimizer.update(agg, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)

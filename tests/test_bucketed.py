@@ -140,6 +140,69 @@ def test_partition_big_leaves_stay_solo():
     assert by_label["tiny"].solo  # 1-member bin demoted, keeps leaf name
 
 
+def test_partition_reverse_order_properties():
+    """order='reverse' (the streaming schedule's backward-completion
+    policy): same coverage/budget/solo invariants as 'trace', fused
+    buckets hold CONTIGUOUS reverse-trace runs, and the bucket list is
+    sorted by descending earliest member — bucket 0 is the first one
+    backprop can close."""
+    names, sizes = list(CENSUS), list(CENSUS.values())
+    specs = partition_buckets(names, sizes, bucket_bytes=4800, order="reverse")
+    placed = [n for s in specs for n in s.names]
+    assert sorted(placed) == sorted(names)  # exactly once, no leaf dropped
+    cap = 4800 // 4
+    index = {n: i for i, n in enumerate(names)}
+    for s in specs:
+        assert s.total == sum(s.sizes)
+        if not s.solo:
+            assert len(s.names) > 1  # 1-member bins still demoted to solo
+            assert s.total <= cap    # budget respected under the new policy
+            # members concatenate in pytree order AND form one contiguous
+            # reverse-trace stretch (no gaps a later bucket fills)
+            idxs = [index[n] for n in s.names]
+            assert idxs == sorted(idxs)
+        else:
+            assert s.names == (s.label,)
+    # backward-completion order: strictly descending earliest member
+    mins = [min(index[n] for n in s.names) for s in specs]
+    assert mins == sorted(mins, reverse=True)
+    # deterministic from (name, size) alone
+    again = partition_buckets(names, sizes, bucket_bytes=4800, order="reverse")
+    assert specs == again
+
+
+def test_partition_reverse_contiguity_differs_from_ffd():
+    """The census where FFD and next-fit-reverse disagree: reverse packs
+    strictly contiguous runs even when size-sorted FFD would bin-pack
+    tighter, and every fused reverse bucket's members are adjacent in
+    reverse-trace order."""
+    names = ["a", "b", "c", "d", "e"]
+    sizes = [500, 100, 500, 100, 500]
+    cap_bytes = 4 * 600
+    rev = partition_buckets(names, sizes, cap_bytes, order="reverse")
+    index = {n: i for i, n in enumerate(names)}
+    for s in rev:
+        if not s.solo:
+            idxs = sorted(index[n] for n in s.names)
+            assert idxs == list(range(idxs[0], idxs[-1] + 1))  # contiguous
+    # walking e,d,c,b,a next-fit with cap 600: [e,d], [c,b], [a]
+    fused = [s.names for s in rev if not s.solo]
+    assert fused == [("d", "e"), ("b", "c")]
+    assert [s.label for s in rev if s.solo] == ["a"]
+
+
+def test_partition_trace_order_is_default_and_unchanged():
+    """order='trace' IS the historical partition: explicit arg, default
+    arg, and the pre-policy call all produce identical specs, so existing
+    configs cannot shift."""
+    names, sizes = list(CENSUS), list(CENSUS.values())
+    default = partition_buckets(names, sizes, bucket_bytes=4800)
+    explicit = partition_buckets(names, sizes, bucket_bytes=4800, order="trace")
+    assert default == explicit
+    with pytest.raises(ValueError, match="order"):
+        partition_buckets(names, sizes, bucket_bytes=4800, order="backward")
+
+
 def test_bucket_budget_is_sum_of_member_budgets():
     """Fusing never changes the total wire slot budget: the bucket codec's
     k is the SUM of its member leaves' per-tensor budgets (rounding and

@@ -1,0 +1,391 @@
+"""Backprop-overlapped streaming bucket exchange (cfg.stream_exchange).
+
+comm_stream.StreamingExchange moves each bucket's encode + all_gather into
+the backward pass via identity custom_vjp hooks. These tests pin its one
+load-bearing contract — the streamed step is BITWISE identical to the
+bucketed barrier and pipeline schedules (same codecs, same PRNG keys, same
+wire bytes; only the dispatch order moves) — plus the satellites:
+
+- exact equality of aggregates, residuals, raw grads, and wire bits vs
+  `bucket_pipeline` on/off, across loop/vmap decode and the stochastic
+  qsgd value codec;
+- donated-buffer chained steps stay bitwise equal;
+- a flat streaming exchange over a two-axis (2, 4) mesh with a tuple
+  axis_name matches the barrier schedule on the same mesh;
+- the adaptive controller still compiles exactly one step executable per
+  ladder rung visited with streaming on (one StreamingExchange per rung);
+- the config validation surface refuses the combinations streaming cannot
+  honor (no buckets, resilience, hier, fed);
+- `costmodel.overlapped_step_time` / `overlap_fraction` against
+  hand-computed cases, including the acceptance bound overlapped <= fused.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from conftest import shared_mesh
+from deepreduce_tpu.comm import GradientExchanger
+from deepreduce_tpu.comm_stream import StreamingExchange
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.utils.compat import shard_map
+
+W = 8
+
+CENSUS = {
+    "emb": 3000, "w1": 900, "w2": 700, "b1": 300, "b2": 150, "b3": 50,
+}
+
+BLOOM_CFG = dict(
+    deepreduce="index", index="bloom", compress_ratio=0.02, fpr=0.01,
+    bloom_blocked="mod", policy="p0", min_compress_size=100,
+)
+QSGD_CFG = dict(
+    deepreduce="both", index="bloom", value="qsgd", policy="p0",
+    compress_ratio=0.05, fpr=0.05, bloom_blocked="mod", min_compress_size=100,
+)
+
+
+def _params(seed=5):
+    rng = np.random.default_rng(seed)
+    return {
+        name: jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+        for name, d in CENSUS.items()
+    }
+
+
+def _batches(seed=7, n=W):
+    rng = np.random.default_rng(seed)
+    return {
+        name: jnp.asarray(
+            (rng.normal(size=(n, d)) * rng.random((n, d)) ** 2).astype(
+                np.float32
+            )
+        )
+        for name, d in CENSUS.items()
+    }
+
+
+def _loss(params, batch_stats, batch):
+    """Per-worker loss with worker-distinct gradients: grad wrt each leaf
+    is batch[name] + p (linear data term + quadratic regularizer)."""
+    loss = sum(
+        jnp.sum(p * batch[name]) + 0.5 * jnp.sum(jnp.square(p))
+        for name, p in params.items()
+    )
+    return loss, batch_stats
+
+
+def _one_step(cfg, params, batch_w, *, step=0, seed=21, mesh=None,
+              in_spec=None):
+    """One full grad+exchange step on the mesh; streamed when
+    cfg.stream_exchange, else value_and_grad + exchanger.exchange exactly
+    as train.make_worker_step. Returns np pytrees
+    (agg, grads[W,...], residuals or None, wire bits)."""
+    tmap = jax.tree_util.tree_map
+    n = jax.tree_util.tree_leaves(batch_w)[0].shape[0]
+    like = tmap(lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32), params)
+    ex = GradientExchanger(
+        like, cfg, num_workers=n,
+        axis_name="data" if mesh is None else mesh.axis_names,
+    )
+    res0 = ex.init_state(tmap(lambda s: jnp.zeros(s.shape, s.dtype), like))
+    has_res = res0 is not None
+    if has_res:
+        res0 = tmap(lambda r: jnp.broadcast_to(r[None], (n,) + r.shape), res0)
+    key = jax.random.PRNGKey(seed)
+    stream = StreamingExchange(ex) if cfg.stream_exchange else None
+    step_arr = jnp.asarray(step)
+
+    def spmd(p, b_w, res):
+        b = tmap(lambda x: x[0], b_w)
+        if has_res:
+            res = tmap(lambda r: r[0], res)
+        if stream is not None:
+            (loss, _), grads, agg, new_res, stats = (
+                stream.value_and_grad_exchange(
+                    _loss, p, {}, b, res, step=step_arr, key=key
+                )
+            )
+        else:
+            (loss, _), grads = jax.value_and_grad(_loss, has_aux=True)(
+                p, {}, b
+            )
+            agg, new_res, stats = ex.exchange(
+                grads, res, step=step_arr, key=key
+            )
+        out_res = tmap(lambda r: r[None], new_res) if has_res else None
+        return (
+            tmap(lambda x: x[None], agg),
+            tmap(lambda g: g[None], grads),
+            out_res,
+            stats.total_bits,
+        )
+
+    mesh = mesh or shared_mesh(n)
+    shard = in_spec if in_spec is not None else P("data")
+    res_spec = P() if not has_res else shard
+    fn = shard_map(
+        spmd,
+        mesh=mesh,
+        in_specs=(P(), shard, res_spec),
+        out_specs=(shard, shard, res_spec, P()),
+        check_vma=False,
+    )
+    agg, grads, res, bits = jax.jit(fn)(params, batch_w, res0)
+    to_np = lambda t: tmap(np.asarray, t)
+    return (
+        to_np(agg),
+        to_np(grads),
+        None if res is None else to_np(res),
+        float(bits),
+    )
+
+
+def _assert_trees_equal(a, b):
+    ja, jb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(ja) == len(jb)
+    for x, y in zip(ja, jb):
+        np.testing.assert_array_equal(x, y)
+
+
+# --------------------------------------------------------------------- #
+# the contract: streaming == pipeline == barrier, bitwise
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize(
+    "codec_cfg", [BLOOM_CFG, QSGD_CFG], ids=["bloom-index", "bloom-qsgd-both"]
+)
+@pytest.mark.parametrize("memory", ["none", "residual"])
+@pytest.mark.parametrize("decode", ["loop", "vmap"])
+def test_streaming_bitwise_equals_bucket_schedules(codec_cfg, memory, decode):
+    """Aggregates, residuals, raw per-worker grads, and wire bits from the
+    streamed step equal the pipeline AND barrier schedules EXACTLY —
+    stochastic value codec included (same per-tensor PRNG keys)."""
+    params = _params()
+    batch_w = _batches()
+    dec = dict(decode_strategy=decode)
+    if decode == "vmap":
+        dec["decode_batch"] = 3
+    base = dict(memory=memory, bucket_bytes=4800, **dec, **codec_cfg)
+    out_s = _one_step(
+        DeepReduceConfig(stream_exchange=True, **base), params, batch_w
+    )
+    out_p = _one_step(DeepReduceConfig(**base), params, batch_w)
+    out_b = _one_step(
+        DeepReduceConfig(bucket_pipeline=False, **base), params, batch_w
+    )
+    for other in (out_p, out_b):
+        _assert_trees_equal(out_s[0], other[0])   # aggregates
+        _assert_trees_equal(out_s[1], other[1])   # raw grads
+        if memory == "residual":
+            _assert_trees_equal(out_s[2], other[2])  # residuals
+        assert out_s[3] == other[3]               # wire bits
+
+
+def test_streaming_bitwise_equal_on_reverse_bucket_order():
+    """bucket_order='reverse' is a shared partition policy: streaming and
+    barrier agree bitwise on it too (they see the same specs)."""
+    params = _params(seed=9)
+    batch_w = _batches(seed=10)
+    base = dict(
+        memory="residual", bucket_bytes=4800, bucket_order="reverse",
+        **BLOOM_CFG,
+    )
+    out_s = _one_step(
+        DeepReduceConfig(stream_exchange=True, **base), params, batch_w
+    )
+    out_b = _one_step(DeepReduceConfig(**base), params, batch_w)
+    _assert_trees_equal(out_s[0], out_b[0])
+    _assert_trees_equal(out_s[2], out_b[2])
+    assert out_s[3] == out_b[3]
+
+
+def test_streaming_donated_chained_steps():
+    """Two chained steps with donated residual buffers (the real training
+    loop's memory discipline) stay bitwise equal to the barrier chain."""
+    params = _params(seed=3)
+    tmap = jax.tree_util.tree_map
+    like = tmap(lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32), params)
+
+    def chain(cfg):
+        ex = GradientExchanger(like, cfg, num_workers=W)
+        stream = StreamingExchange(ex) if cfg.stream_exchange else None
+        key = jax.random.PRNGKey(13)
+
+        def spmd(p, b_w, res, step):
+            b = tmap(lambda x: x[0], b_w)
+            res = tmap(lambda r: r[0], res)
+            if stream is not None:
+                _, _, agg, new_res, _ = stream.value_and_grad_exchange(
+                    _loss, p, {}, b, res, step=step, key=key
+                )
+            else:
+                _, grads = jax.value_and_grad(_loss, has_aux=True)(p, {}, b)
+                agg, new_res, _ = ex.exchange(grads, res, step=step, key=key)
+            return (
+                tmap(lambda x: x[None], agg),
+                tmap(lambda r: r[None], new_res),
+            )
+
+        fn = shard_map(
+            spmd,
+            mesh=shared_mesh(W),
+            in_specs=(P(), P("data"), P("data"), P()),
+            out_specs=(P("data"), P("data")),
+            check_vma=False,
+        )
+        # residual buffer donated each step, as Trainer's loop donates state
+        jfn = jax.jit(fn, donate_argnums=(2,))
+        res = tmap(
+            lambda p: jnp.zeros((W,) + p.shape, jnp.float32), params
+        )
+        for step in range(2):
+            agg, res = jfn(
+                params, _batches(seed=40 + step), res, jnp.asarray(step)
+            )
+        return tmap(np.asarray, agg), tmap(np.asarray, res)
+
+    base = dict(memory="residual", bucket_bytes=4800, **QSGD_CFG)
+    agg_s, res_s = chain(DeepReduceConfig(stream_exchange=True, **base))
+    agg_b, res_b = chain(DeepReduceConfig(bucket_pipeline=False, **base))
+    _assert_trees_equal(agg_s, agg_b)
+    _assert_trees_equal(res_s, res_b)
+
+
+def test_streaming_on_two_axis_mesh():
+    """The rejected-hier escape hatch: a FLAT streaming exchange over a
+    (2, 4) two-axis mesh with the tuple axis_name ('dcn', 'ici') — the
+    collectives span both axes, and streaming matches the barrier schedule
+    on the same mesh bitwise."""
+    params = _params(seed=15)
+    batch_w = _batches(seed=16)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+    spec = P(("dcn", "ici"))
+    base = dict(memory="residual", bucket_bytes=4800, **BLOOM_CFG)
+    out_s = _one_step(
+        DeepReduceConfig(stream_exchange=True, **base), params, batch_w,
+        mesh=mesh, in_spec=spec,
+    )
+    out_b = _one_step(
+        DeepReduceConfig(bucket_pipeline=False, **base), params, batch_w,
+        mesh=mesh, in_spec=spec,
+    )
+    _assert_trees_equal(out_s[0], out_b[0])
+    _assert_trees_equal(out_s[2], out_b[2])
+    assert out_s[3] == out_b[3]
+
+
+# --------------------------------------------------------------------- #
+# controller composition: one executable per rung, streaming on
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_controller_rung_cache_with_streaming(tmp_path):
+    """With stream_exchange on, the adaptive run still compiles exactly
+    one step executable per ladder rung visited — a StreamingExchange is
+    built per rung inside make_worker_step, never per step."""
+    from deepreduce_tpu.controller.__main__ import _build_cfg, _run_train
+
+    cfg = _build_cfg(bucket_bytes=4800, stream_exchange=True)
+    log = tmp_path / "decisions.jsonl"
+    losses, trainer, _ = _run_train(cfg, steps=50, num_workers=8, log_path=log)
+    assert all(l == l for l in losses)  # finite
+    visited = trainer.visited_ladder_indices
+    assert len(trainer._step_cache) == len(visited)
+    assert trainer.controller.switches >= 1  # it actually adapted
+    sizes = [
+        fn._cache_size()
+        for fn in trainer._step_cache.values()
+        if hasattr(fn, "_cache_size")
+    ]
+    if sizes:
+        assert sum(sizes) == len(visited), sizes
+
+
+# --------------------------------------------------------------------- #
+# validation surface
+# --------------------------------------------------------------------- #
+
+
+def test_streaming_config_validation():
+    with pytest.raises(ValueError, match="bucket_bytes"):
+        DeepReduceConfig(stream_exchange=True, **BLOOM_CFG)
+    with pytest.raises(ValueError, match="resilience"):
+        DeepReduceConfig(
+            stream_exchange=True, bucket_bytes=4096, resilience=True,
+            **BLOOM_CFG,
+        )
+    with pytest.raises(ValueError, match="hier"):
+        DeepReduceConfig(
+            stream_exchange=True, bucket_bytes=4096, hier=True, **BLOOM_CFG
+        )
+    with pytest.raises(ValueError, match="fed"):
+        DeepReduceConfig(
+            stream_exchange=True, bucket_bytes=4096, fed=True, **BLOOM_CFG
+        )
+    with pytest.raises(ValueError, match="bucket_order"):
+        DeepReduceConfig(
+            bucket_bytes=4096, bucket_order="nope", **BLOOM_CFG
+        )
+    with pytest.raises(ValueError, match="bucket_order"):
+        DeepReduceConfig(bucket_order="reverse", **BLOOM_CFG)
+
+
+def test_streaming_needs_bucketed_exchanger():
+    like = {"x": jax.ShapeDtypeStruct((4096,), jnp.float32)}
+    ex = GradientExchanger(
+        like, DeepReduceConfig(memory="none", **BLOOM_CFG), num_workers=W
+    )
+    with pytest.raises(ValueError, match="bucket_bytes"):
+        StreamingExchange(ex)
+
+
+# --------------------------------------------------------------------- #
+# cost model: overlapped_step_time / overlap_fraction
+# --------------------------------------------------------------------- #
+
+
+def test_overlapped_step_time_hand_computed():
+    from deepreduce_tpu import costmodel as cm
+
+    m = {"payload_bytes": 1e6, "t_encode_s": 0.5, "t_decode_s": 0.25}
+    bw = 12.5e6
+    wire = (8 - 1) * 1e6 / bw  # allgather_time = 0.56 s
+    # no compute to hide behind: identical to the fused serialized model
+    assert cm.overlapped_step_time(m, 8, bw) == cm.fused_step_time(m, 8, bw)
+    # partial hiding: exposed wire shrinks by exactly compute_time
+    t = cm.overlapped_step_time(m, 8, bw, compute_time=0.2)
+    assert t == pytest.approx(0.5 + (wire - 0.2) + 8 * 0.25)
+    # full hiding: only encode + decode remain, monotone floor
+    t_full = cm.overlapped_step_time(m, 8, bw, compute_time=10.0)
+    assert t_full == pytest.approx(0.5 + 8 * 0.25)
+    assert cm.overlapped_step_time(m, 8, bw, compute_time=20.0) == t_full
+    # negative compute_time never helps (clamped to 0)
+    assert cm.overlapped_step_time(
+        m, 8, bw, compute_time=-1.0
+    ) == cm.fused_step_time(m, 8, bw)
+    # the acceptance bound: overlapped <= fused, always
+    for ct in (0.0, 0.1, 0.56, 3.0):
+        assert cm.overlapped_step_time(m, 8, bw, compute_time=ct) <= (
+            cm.fused_step_time(m, 8, bw)
+        )
+
+
+def test_overlap_fraction_hand_computed():
+    from deepreduce_tpu import costmodel as cm
+
+    m = {"payload_bytes": 1e6, "t_encode_s": 0.0, "t_decode_s": 0.0}
+    bw = 12.5e6
+    wire = (8 - 1) * 1e6 / bw
+    assert cm.overlap_fraction(m, 8, bw) == 0.0
+    assert cm.overlap_fraction(m, 8, bw, compute_time=wire / 2) == pytest.approx(0.5)
+    assert cm.overlap_fraction(m, 8, bw, compute_time=wire * 3) == 1.0
+    assert cm.overlap_fraction(m, 8, bw, compute_time=-1.0) == 0.0
+    # degenerate zero-wire measurement: everything is hidden by definition
+    z = {"payload_bytes": 0.0, "t_encode_s": 0.0, "t_decode_s": 0.0}
+    assert cm.overlap_fraction(z, 8, bw) == 1.0

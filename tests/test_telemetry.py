@@ -348,6 +348,74 @@ def test_cli_trace_merges_spans_and_counters(tmp_path, capsys):
     assert all(e["ph"] == "C" for e in merged["traceEvents"])
 
 
+def _x(name, ts, dur):
+    return {"name": name, "ph": "X", "ts": float(ts), "dur": float(dur),
+            "pid": 1, "tid": 0}
+
+
+def test_cli_trace_overlap(tmp_path, capsys):
+    """`trace RUN --overlap` — the streaming-exchange CI gate. A run whose
+    exchange/bucket/* spans sit inside train/forward_backward passes; a
+    barrier-shaped run (buckets dispatched after backward) exits 1; runs
+    without the span structure are data errors (exit 2)."""
+    # streaming shape: every bucket dispatch inside the fwd+bwd interval
+    streaming = [
+        _x("train/forward_backward", 0, 1000),
+        _x("exchange/bucket/emb", 100, 100),
+        _x("exchange/bucket/bucket0", 300, 150),
+        _x("exchange/bucket/bucket1", 600, 100),
+        _x("train/apply_updates", 1010, 50),
+    ]
+    run = _write_run(tmp_path, "stream", trace_events=streaming)
+    assert cli.main(["trace", str(run), "--overlap"]) == 0
+    out = capsys.readouterr().out
+    assert "fraction 1.000" in out and "ok" in out
+    # barrier shape: buckets fire after forward_backward ends -> fraction 0
+    barrier = [
+        _x("train/forward_backward", 0, 1000),
+        _x("exchange/bucket/emb", 1100, 100),
+        _x("exchange/bucket/bucket0", 1250, 150),
+    ]
+    run_b = _write_run(tmp_path, "barrier", trace_events=barrier)
+    assert cli.main(["trace", str(run_b), "--overlap"]) == 1
+    assert "BELOW THRESHOLD" in capsys.readouterr().out
+    # partial overlap straddling the boundary: 50% in -> threshold decides
+    partial = [
+        _x("train/forward_backward", 0, 1000),
+        _x("exchange/bucket/emb", 900, 200),
+    ]
+    run_p = _write_run(tmp_path, "partial", trace_events=partial)
+    assert cli.main(
+        ["trace", str(run_p), "--overlap", "--overlap-threshold", "0.4"]
+    ) == 0
+    capsys.readouterr()
+    assert cli.main(
+        ["trace", str(run_p), "--overlap", "--overlap-threshold", "0.6"]
+    ) == 1
+    capsys.readouterr()
+    # multi-step attribution: each bucket scored against ITS step's window
+    two_step = [
+        _x("train/forward_backward", 0, 1000),
+        _x("exchange/bucket/emb", 500, 100),     # step 0, inside
+        _x("train/forward_backward", 2000, 1000),
+        _x("exchange/bucket/emb", 3200, 100),    # step 1, after bwd
+    ]
+    run_2 = _write_run(tmp_path, "two", trace_events=two_step)
+    assert cli.main(
+        ["trace", str(run_2), "--overlap", "--overlap-threshold", "0.4"]
+    ) == 0
+    assert "step 0" in capsys.readouterr().out
+    # no forward_backward spans / no bucket spans / no trace: data errors
+    no_fb = _write_run(tmp_path, "nofb",
+                       trace_events=[_x("exchange/bucket/emb", 0, 10)])
+    assert cli.main(["trace", str(no_fb), "--overlap"]) == 2
+    no_bk = _write_run(tmp_path, "nobk",
+                       trace_events=[_x("train/forward_backward", 0, 10)])
+    assert cli.main(["trace", str(no_bk), "--overlap"]) == 2
+    bare = _write_run(tmp_path, "notrace")
+    assert cli.main(["trace", str(bare), "--overlap"]) == 2
+
+
 def test_cli_telemetry_off_notice(tmp_path, capsys):
     """summary/trace on a telemetry-off run dir print a clean notice
     instead of partial or KeyError-prone output, and still exit 0."""

@@ -1,4 +1,4 @@
-.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check fedsim-check ctrl-check overlap-check
+.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check fedsim-check ctrl-check overlap-check calibrate-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
@@ -6,7 +6,7 @@
 # telemetry round trip (telemetry-check), the resilience smoke
 # (chaos-check) and the federated round smoke (fedsim-check) so none of
 # those paths can rot while the gate stays green.
-analyze: telemetry-check chaos-check fedsim-check ctrl-check overlap-check
+analyze: telemetry-check chaos-check fedsim-check ctrl-check overlap-check calibrate-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
 
 # adaptive-controller smoke: a short adaptive train on the 8-worker CPU
@@ -66,6 +66,27 @@ overlap-check:
 		rd=lambda n:[(r['loss'],r['rel_volume']) for r in map(json.loads, open('$(OVERLAP_CHECK_DIR)/'+n+'/metrics.jsonl'))]; \
 		a,b=rd('stream'),rd('barrier'); \
 		sys.exit(0 if a==b and a else (print('overlap-check: metrics diverge',a,b),1)[1])"
+
+# cost-model calibration gate: a short telemetry-on train on the
+# 8-worker CPU mesh writes a tracked run dir, then `telemetry calibrate`
+# fits a MachineProfile from its trace + wire accumulators and exits
+# nonzero unless the fitted model reproduces the measured (warmup-
+# dropped) step time within tolerance and the profile record passes
+# schema validation. A second fit must be byte-identical — the fit reads
+# only recorded telemetry, never the wall clock.
+CALIB_CHECK_DIR := /tmp/drtpu_calib_check
+calibrate-check:
+	rm -rf $(CALIB_CHECK_DIR)
+	JAX_PLATFORMS=cpu python benchmarks/train.py --platform cpu \
+		--model mlp --num_steps 8 --batch_size 8 --num_workers 8 --seed 0 \
+		--telemetry --track_dir $(CALIB_CHECK_DIR) --run_name calib \
+		--log_every 0 \
+		--grace_config "{'compressor':'topk','compress_ratio':0.05,'deepreduce':'index','index':'bloom','fpr':0.01,'memory':'residual'}"
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry calibrate \
+		$(CALIB_CHECK_DIR)/calib --out $(CALIB_CHECK_DIR)/profile.json
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry calibrate \
+		$(CALIB_CHECK_DIR)/calib --out $(CALIB_CHECK_DIR)/profile2.json
+	cmp $(CALIB_CHECK_DIR)/profile.json $(CALIB_CHECK_DIR)/profile2.json
 
 # end-to-end telemetry round trip on the CPU virtual mesh: a short
 # telemetry-on training run writes a tracked run dir (metrics + device

@@ -205,6 +205,15 @@ def _costmodel():
     return costmodel
 
 
+def _provenance(modeled, measured) -> dict:
+    """Honesty stamp on every committed record: which detail fields are
+    cost-model arithmetic and which came off a clock. A reader (or the
+    `telemetry compare --profile` re-pricer) must be able to tell a modeled
+    claim — re-derivable from static constants or a fitted profile — from a
+    measurement that only a re-run can reproduce."""
+    return {"modeled": sorted(modeled), "measured": sorted(measured)}
+
+
 def _latest_midround_record() -> str:
     """Newest committed BENCH_TPU_MIDROUND_*.json, or '' if none exist."""
     import pathlib
@@ -783,6 +792,12 @@ def rs_sweep(quick: bool = False, workers: int = 8) -> dict:
         "metric": "in_collective_rs_vs_fused_bloom_step_time",
         "unit": "s",
         "platform": "cpu",
+        "provenance": _provenance(
+            modeled=[
+                "W8", "W16", "wire_bytes_per_collective", "dense_allreduce_s",
+            ],
+            measured=["rs_compute_s_per_worker", "bloom_measurements"],
+        ),
         "detail": {
             "model": "stackoverflow_lstm" if not quick else "quick",
             "d": d,
@@ -911,6 +926,14 @@ def hier_sweep(quick: bool = False, n_slices: int = 8, per_slice: int = 4) -> di
         "metric": "hier_two_tier_vs_flat_step_time",
         "unit": "s",
         "platform": "cpu",
+        "provenance": _provenance(
+            modeled=[
+                "auto_plan", "hier_plan_table_s", "flat_step_s",
+                "dense_allreduce_s", "speedup_hier_vs_best_flat",
+                "speedup_hier_vs_dense",
+            ],
+            measured=["measured_virtual_mesh"],
+        ),
         "detail": {
             "model": "stackoverflow_lstm" if not quick else "quick",
             "d": d,
@@ -1027,6 +1050,18 @@ def fed_sweep(quick: bool = False, workers: int = 8) -> dict:
         "value": arms[best]["measured_clients_per_sec"],
         "unit": "clients/s",
         "platform": "cpu",
+        "provenance": _provenance(
+            modeled=[
+                "cohorts.*.modeled_100mbps_round_s",
+                "cohorts.*.modeled_100mbps_clients_per_sec",
+            ],
+            measured=[
+                "cohorts.*.measured_round_s",
+                "cohorts.*.measured_clients_per_sec",
+                "cohorts.*.uplink_bytes_per_round",
+                "cohorts.*.downlink_bytes",
+            ],
+        ),
         "detail": {
             "population": population,
             "dim": dim,
@@ -1144,6 +1179,14 @@ def ctrl_sweep(quick: bool = False, workers: int = 8) -> dict:
         "value": round(wire_ratio, 4),
         "unit": "x (adaptive wire bytes/step over best fixed arm's)",
         "platform": "cpu",
+        "provenance": _provenance(
+            modeled=["arms.*.modeled_100mbps_exchange_s"],
+            measured=[
+                "arms.*.final_loss", "arms.*.best_loss",
+                "arms.*.wire_bytes_per_step", "arms.*.rel_volume",
+                "arms.*.compress_err_cos",
+            ],
+        ),
         "detail": {
             "steps": steps,
             "workers": workers,
@@ -1158,6 +1201,106 @@ def ctrl_sweep(quick: bool = False, workers: int = 8) -> dict:
                 adaptive["final_loss"] - fixed[best]["final_loss"], 6
             ),
             "arms": arms,
+        },
+    }
+
+
+def calib_sweep(quick: bool = False, run: str = "TRACE_OVERLAP_r15") -> dict:
+    """The self-calibrating cost-model arm (`--calib-sweep`): fit a
+    MachineProfile from the committed tracking run (`costmodel.calibrate`
+    over TRACE_OVERLAP_r15 — deterministic: the fit reads only recorded
+    telemetry, so re-running this arm reproduces the record byte for
+    byte), then re-run `select_hier_plan` at a sweep of deployment shapes
+    under the fitted profile next to the static-constants pick.
+
+    Each point prices BOTH picks under BOTH models, so the record shows
+    not just *that* the calibrated planner disagrees but what the
+    disagreement is worth on the machine the profile was fitted on. The
+    flip-prone shape is the small-slice-count hierarchy (2x16): statically
+    the fused DCN leg wins at n_slices=2 because its (W-1)-scaled
+    allgather is cheap, but the fitted profile charges the measured encode
+    seconds on exactly that leg (the only profile-sensitive row — the rs
+    routes are wire-only, so a bandwidth rescale cannot reorder them) and
+    the planner walks away from it. `telemetry compare --profile P
+    --against BENCH_CALIB_*.json` replays these points from `detail.points`.
+    """
+    import pathlib
+
+    cm = _costmodel()
+    prof = cm.calibrate(pathlib.Path(__file__).parent / run)
+    d = LSTM_D
+    shapes = ((2, 16), (8, 4)) if not quick else ((2, 16),)
+    ratios = (0.001, 0.01, 0.1)
+    points = []
+    disagreements = 0
+    wins = 0
+    for n_slices, per_slice in shapes:
+        for ratio in ratios:
+            static = cm.select_hier_plan(d, n_slices, per_slice, ratio)
+            calib = cm.select_hier_plan(
+                d, n_slices, per_slice, ratio, profile=prof
+            )
+            s_key = f"{static['ici']}+{static['dcn']}"
+            c_key = f"{calib['ici']}+{calib['dcn']}"
+            disagree = s_key != c_key
+            win = calib["table"][c_key] < calib["table"][s_key]
+            disagreements += int(disagree)
+            wins += int(disagree and win)
+            points.append(
+                {
+                    "d": d,
+                    "ratio": ratio,
+                    "n_slices": n_slices,
+                    "per_slice": per_slice,
+                    "static_pick": s_key,
+                    "calibrated_pick": c_key,
+                    # both picks under both models: rows are the pick,
+                    # columns the model that priced it
+                    "static_pick_static_s": round(static["table"][s_key], 4),
+                    "static_pick_fitted_s": round(calib["table"][s_key], 4),
+                    "calibrated_pick_static_s": round(static["table"][c_key], 4),
+                    "calibrated_pick_fitted_s": round(calib["table"][c_key], 4),
+                    "disagree": disagree,
+                    "calibrated_wins_under_fitted": bool(win),
+                    "speedup_under_fitted": round(
+                        calib["table"][s_key] / calib["table"][c_key], 3
+                    ),
+                }
+            )
+            _progress(
+                f"calib-sweep: {n_slices}x{per_slice} ratio={ratio:g}: "
+                f"static {s_key} vs calibrated {c_key}"
+                + (" (DISAGREE)" if disagree else "")
+            )
+    return {
+        "metric": "calibrated_vs_static_hier_plan_picks",
+        "value": disagreements,
+        "unit": "pick disagreements across the sweep",
+        "platform": "cpu",
+        "provenance": _provenance(
+            modeled=[
+                "points.*.static_pick", "points.*.calibrated_pick",
+                "points.*.static_pick_static_s",
+                "points.*.static_pick_fitted_s",
+                "points.*.calibrated_pick_static_s",
+                "points.*.calibrated_pick_fitted_s",
+            ],
+            measured=["profile"],
+        ),
+        "detail": {
+            "run": run,
+            "d": d,
+            "ratios": list(ratios),
+            "shapes": [f"{n}x{p}" for n, p in shapes],
+            "cost_model": (
+                "select_hier_plan argmin, static constants vs the profile "
+                "fitted by costmodel.calibrate from the committed tracking "
+                "run's telemetry"
+            ),
+            "profile": prof.to_record(),
+            "disagreements": disagreements,
+            "calibrated_wins_under_fitted": wins,
+            "points": points,
         },
     }
 
@@ -1183,6 +1326,9 @@ def main() -> None:
                     "metric": "fused_exchange_decode_strategy_step_time",
                     "unit": "s",
                     "platform": "cpu",
+                    "provenance": _provenance(
+                        modeled=[], measured=["strategies"]
+                    ),
                     "detail": {
                         "model": "stackoverflow_lstm" if not quick else "quick",
                         "d": d,
@@ -1218,6 +1364,16 @@ def main() -> None:
         force_platform("cpu", device_count=8)
         print(json.dumps(ctrl_sweep(quick="--quick" in sys.argv)))
         return
+    if "--calib-sweep" in sys.argv:
+        # standalone self-calibration arm: no mesh needed — the fit reads
+        # committed telemetry and the pricing is closed-form (committed as
+        # BENCH_CALIB_*.json). Platform still pinned: the package __init__
+        # pulls in jax, which must not dial the device tunnel here.
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu")
+        print(json.dumps(calib_sweep(quick="--quick" in sys.argv)))
+        return
     if "--rs-sweep" in sys.argv:
         # standalone in-collective sweep mode: CPU-mesh only, one JSON
         # record on stdout (committed as BENCH_INCOLL_*.json)
@@ -1242,6 +1398,16 @@ def main() -> None:
                     "value": rec.get("bucketed_speedup_vs_pertensor"),
                     "unit": "x",
                     "platform": "cpu",
+                    "provenance": _provenance(
+                        modeled=[
+                            "overlap_model.t_allgather_s",
+                            "overlap_model.t_serialized_s",
+                            "overlap_model.t_pipelined_r09_s",
+                            "overlap_model.t_streaming_full_overlap_s",
+                            "overlap_model.curve",
+                        ],
+                        measured=["detail.arms", "overlap_model.measurement"],
+                    ),
                     "detail": rec,
                     "overlap_model": overlap,
                 }
@@ -1487,6 +1653,18 @@ def main() -> None:
                 "value": round(best, 3),
                 "unit": "x",
                 "vs_baseline": round(best / PAPER_E2E_SPEEDUP, 4),
+                "provenance": _provenance(
+                    modeled=[
+                        "t_dense_s", "configs.*.e2e_speedup_vs_dense",
+                        "speedup_vs_topr",
+                    ],
+                    measured=[
+                        "configs.*.t_encode_s", "configs.*.t_decode_s",
+                        "configs.*.rel_volume", "dispatch_overhead_s",
+                        "measured_exchange", "decode_strategy_sweep",
+                        "bucketed_exchange", "model_throughput",
+                    ],
+                ),
                 "detail": detail,
             }
         )

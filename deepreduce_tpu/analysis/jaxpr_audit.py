@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from deepreduce_tpu import memory
 from deepreduce_tpu.analysis.rules import (
     AuditContext,
+    R_CALIB_RESELECT,
     R_CTRL_LADDER,
     R_RESILIENCE_OFF,
     R_RETRACE,
@@ -282,6 +283,7 @@ def audit_exchange(
     expect_codec: Optional[int] = None,
     with_mask: bool = False,
     mesh=None,
+    profile=None,
 ) -> List[TraceRecord]:
     """Trace one full `exchange` step inside shard_map on the 8-way mesh.
 
@@ -291,6 +293,8 @@ def audit_exchange(
     sparsifier-selection eqns (O(leaves) per-tensor, O(buckets) bucketed).
     `with_mask` threads a replicated bool[W] participation mask into the
     exchange — the resilient-path audit shape (requires memory='residual').
+    `profile` hands the exchanger a costmodel.MachineProfile for its
+    construction-time 'auto' selection (the calib-reselect audit shape).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -300,7 +304,10 @@ def audit_exchange(
         grads_like: Any = _sds((d,))
     else:
         grads_like = {n: _sds((int(sz),)) for n, sz in leaves.items()}
-    ex = GradientExchanger(grads_like, cfg, axis_name=AXIS, num_workers=NUM_WORKERS)
+    ex = GradientExchanger(
+        grads_like, cfg, axis_name=AXIS, num_workers=NUM_WORKERS,
+        profile=profile,
+    )
     with_state = cfg.memory == "residual"
     pb = ex.payload_bytes(grads_like) if wire_mode is not None else None
     g_w = tmap(lambda s: _sds((NUM_WORKERS,) + s.shape), grads_like)
@@ -733,6 +740,127 @@ def audit_streaming_exchange() -> List[TraceRecord]:
     return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
 
 
+def audit_calib_reselect() -> List[TraceRecord]:
+    """The calibration no-op contract (jx-calib-reselect), in two halves.
+
+    Selector identity: `costmodel.static_profile()` encodes exactly the
+    static constants, so threading it through `select_rs_mode` and
+    `select_hier_plan` must change NOTHING — same pick, and the same
+    candidate table to the last float — across a shape sweep that
+    includes the flip-prone small-slice-count hierarchy (2x16, the shape
+    a genuinely *fitted* profile does flip in BENCH_CALIB_r16). If this
+    half ever fires, the profile plumbing is biased: it would re-price
+    candidates even when telemetry taught us nothing.
+
+    Program identity: an rs_mode='auto' exchange traced with
+    profile=static_profile() must be byte-identical (same jaxpr hash) to
+    the same config traced with no profile at all. Profiles act at
+    construction-time selection only — they must leave zero residue in
+    the traced program, so `Trainer.apply_profile`'s bounded-retrace
+    accounting (one executable per visited plan key) stays honest.
+
+    The final digest record folds every hash and every pick into one
+    sha256, so re-baselining ANALYSIS.json catches a selector pick
+    drifting even while both arms keep agreeing with each other.
+    """
+    import hashlib
+
+    from deepreduce_tpu import costmodel
+
+    prof = costmodel.static_profile()
+    violations: List[Violation] = []
+    picks: List[str] = []
+
+    # --- selector identity sweep -------------------------------------- #
+    for d in (4096, 4_053_428):
+        for ratio in (0.001, 0.01, 0.1):
+            for W in (8, 32):
+                base = costmodel.select_rs_mode(d, W, ratio)
+                with_p = costmodel.select_rs_mode(d, W, ratio, profile=prof)
+                picks.append(f"rs:{d}:{W}:{ratio}:{base}")
+                if base != with_p:
+                    violations.append(
+                        Violation(
+                            R_CALIB_RESELECT,
+                            "calib:selector-identity",
+                            f"select_rs_mode(d={d}, W={W}, ratio={ratio}) "
+                            f"flipped {base!r} -> {with_p!r} under "
+                            "static_profile() — the constants-equivalent "
+                            "profile must be a no-op",
+                        )
+                    )
+            for n_slices, per_slice in ((8, 4), (2, 16)):
+                base = costmodel.select_hier_plan(d, n_slices, per_slice, ratio)
+                with_p = costmodel.select_hier_plan(
+                    d, n_slices, per_slice, ratio, profile=prof
+                )
+                picks.append(
+                    f"hier:{d}:{n_slices}x{per_slice}:{ratio}:"
+                    f"{base['ici']}+{base['dcn']}"
+                )
+                if (base["ici"], base["dcn"]) != (with_p["ici"], with_p["dcn"]):
+                    violations.append(
+                        Violation(
+                            R_CALIB_RESELECT,
+                            "calib:selector-identity",
+                            f"select_hier_plan(d={d}, {n_slices}x{per_slice}, "
+                            f"ratio={ratio}) flipped "
+                            f"{base['ici']}+{base['dcn']} -> "
+                            f"{with_p['ici']}+{with_p['dcn']} under "
+                            "static_profile()",
+                        )
+                    )
+                elif base["table"] != with_p["table"]:
+                    violations.append(
+                        Violation(
+                            R_CALIB_RESELECT,
+                            "calib:selector-identity",
+                            f"select_hier_plan(d={d}, {n_slices}x{per_slice}, "
+                            f"ratio={ratio}) kept its pick but re-priced the "
+                            "candidate table under static_profile() — the "
+                            "constants-equivalent profile must not move a "
+                            "single float",
+                        )
+                    )
+
+    # --- traced-program identity --------------------------------------- #
+    cfg = DeepReduceConfig(
+        communicator="sparse_rs", compressor="topk", memory="none",
+        deepreduce=None, compress_ratio=0.01, rs_mode="auto",
+    )
+    (rec_off,) = audit_exchange("calib:auto-no-profile", cfg, d=4096)
+    (rec_on,) = audit_exchange(
+        "calib:auto-static-profile", cfg, d=4096, profile=prof
+    )
+    if rec_off.jaxpr_hash != rec_on.jaxpr_hash:
+        violations.append(
+            Violation(
+                R_CALIB_RESELECT,
+                "calib:program-identity",
+                f"rs_mode='auto' exchange traced with static_profile() "
+                f"({rec_on.jaxpr_hash}) differs from the profile-free trace "
+                f"({rec_off.jaxpr_hash}) — profiles must act at "
+                "construction-time selection only and leave no residue in "
+                "the step program",
+            )
+        )
+    return [
+        rec_off,
+        rec_on,
+        TraceRecord(
+            label="calib:reselect-identity",
+            violations=violations,
+            collectives={},
+            # digest over both traced hashes and every static selector
+            # pick: re-baselining pins the picks themselves, not just the
+            # agreement between the two arms
+            jaxpr_hash=hashlib.sha256(
+                "".join([rec_off.jaxpr_hash, rec_on.jaxpr_hash] + picks).encode()
+            ).hexdigest()[:16],
+        ),
+    ]
+
+
 # ---------------------------------------------------------------------- #
 # the audited configuration inventory
 # ---------------------------------------------------------------------- #
@@ -1121,6 +1249,7 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
     # dispatch moved into the backward pass (registered last so the
     # pre-existing record order — and ANALYSIS.json hashes — are stable) ---
     add("exchange:streaming", lambda: audit_streaming_exchange())
+    add("calib:reselect", lambda: audit_calib_reselect())
     return specs
 
 

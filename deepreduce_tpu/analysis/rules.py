@@ -51,6 +51,13 @@ R_RESILIENCE_OFF = "jx-resilience-off-identical"
 # config at a rung must trace byte-identical to a plain fixed config at
 # the same operating point (the controller is host-side only)
 R_CTRL_LADDER = "jx-ctrl-ladder"
+# emitted by the audit harness (audit_calib_reselect): the fitted-profile
+# re-selection contract — a MachineProfile that restates the static
+# constants (costmodel.static_profile) must change NO selector's pick
+# across the shape sweep, and an 'auto' exchange built with that profile
+# must trace byte-identical to one built with no profile at all
+# (re-selection swaps which cached program runs; it never edits a program)
+R_CALIB_RESELECT = "jx-calib-reselect"
 
 ALL_RULE_IDS = (
     R_F64,
@@ -64,6 +71,7 @@ ALL_RULE_IDS = (
     R_RETRACE,
     R_RESILIENCE_OFF,
     R_CTRL_LADDER,
+    R_CALIB_RESELECT,
 )
 
 # sparsifier-selection primitives: every TensorCodec encode lowers its

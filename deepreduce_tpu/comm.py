@@ -241,6 +241,7 @@ class GradientExchanger:
         axis_name: str = "data",
         num_workers: Optional[int] = None,
         bucket_points: Optional[Any] = None,
+        profile: Optional[Any] = None,
     ):
         self.cfg = cfg
         self.axis_name = axis_name
@@ -276,7 +277,14 @@ class GradientExchanger:
         # resolve the sparse_rs route once, at construction: 'auto' asks the
         # shared W-aware cost model (costmodel.select_rs_mode) to argmin the
         # ring wire time of the concrete routes from (d, W, ratio) — the
-        # traced exchange only ever sees a concrete mode
+        # traced exchange only ever sees a concrete mode. An explicit
+        # `profile=` (or cfg.profile path) prices the argmin with fitted
+        # machine constants instead of the static ones.
+        from deepreduce_tpu import costmodel
+
+        if profile is None and cfg.profile is not None:
+            profile = costmodel.load_profile(cfg.profile)
+        self.profile = profile
         self._rs_mode = cfg.rs_mode
         if cfg.communicator == "sparse_rs" and cfg.rs_mode == "auto":
             if num_workers is None:
@@ -285,8 +293,6 @@ class GradientExchanger:
                     "at construction and needs the static mesh size: "
                     "construct GradientExchanger(..., num_workers=...)"
                 )
-            from deepreduce_tpu import costmodel
-
             d = sum(
                 int(math.prod(l.shape)) if l.shape else 1
                 for l in jax.tree_util.tree_leaves(grads_like)
@@ -300,6 +306,7 @@ class GradientExchanger:
                 block=cfg.rs_block_size,
                 rows=cfg.rs_sketch_rows,
                 cols=cfg.rs_sketch_cols,
+                profile=profile,
             )
         leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
         self.names = [_leaf_name(path) for path, _ in leaves]
@@ -822,10 +829,15 @@ class GradientExchanger:
             key = jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
         else:
             key = None
-        compensated = grads
-        if state is not None:
-            compensated = memory.compensate(grads, state, beta=cfg.beta, gamma=cfg.gamma)
-        flat, unravel = ravel_pytree(compensated)
+        # encode/decode sub-spans make t_enc/t_dec separately identifiable
+        # to costmodel.calibrate; the wire work stays under exchange/sparse_rs
+        with spans.span("exchange/encode"):
+            compensated = grads
+            if state is not None:
+                compensated = memory.compensate(
+                    grads, state, beta=cfg.beta, gamma=cfg.gamma
+                )
+            flat, unravel = ravel_pytree(compensated)
         with spans.span("exchange/sparse_rs"):
             mean, own_flat, stats = sparse_rs.exchange(
                 flat.astype(jnp.float32),
@@ -844,11 +856,12 @@ class GradientExchanger:
                 key=key,
                 collect=collect,
             )
-        agg = unravel(mean.astype(flat.dtype))
-        new_state = state
-        if state is not None:
-            own = unravel(own_flat.astype(flat.dtype))
-            new_state = memory.update(compensated, own)
+        with spans.span("exchange/decode"):
+            agg = unravel(mean.astype(flat.dtype))
+            new_state = state
+            if state is not None:
+                own = unravel(own_flat.astype(flat.dtype))
+                new_state = memory.update(compensated, own)
         return agg, new_state, stats
 
     def _exchange_qar(
@@ -866,13 +879,16 @@ class GradientExchanger:
             )
         from jax.flatten_util import ravel_pytree
 
-        flat, unravel = ravel_pytree(grads)
-        d = flat.shape[0]
-        n = qar.pad_len(d, self.num_workers, cfg.bucket_size)
-        # quantization scales and dequantized sums are f32; cast up front so
-        # bf16 inputs get f32 bucket norms, and hand leaves back in their own
-        # dtype like the psum branch does
-        padded = jnp.zeros((n,), jnp.float32).at[:d].set(flat.astype(jnp.float32))
+        with spans.span("exchange/encode"):
+            flat, unravel = ravel_pytree(grads)
+            d = flat.shape[0]
+            n = qar.pad_len(d, self.num_workers, cfg.bucket_size)
+            # quantization scales and dequantized sums are f32; cast up front
+            # so bf16 inputs get f32 bucket norms, and hand leaves back in
+            # their own dtype like the psum branch does
+            padded = (
+                jnp.zeros((n,), jnp.float32).at[:d].set(flat.astype(jnp.float32))
+            )
         if key is None:
             key = jax.random.PRNGKey(cfg.seed)
         key = jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
@@ -886,7 +902,8 @@ class GradientExchanger:
                 bucket_size=cfg.bucket_size,
                 use_pallas=cfg.use_pallas,
             )[:d]
-        agg = unravel(mean.astype(flat.dtype))
+        with spans.span("exchange/decode"):
+            agg = unravel(mean.astype(flat.dtype))
         # one payload (int8 levels + f32 norms) per phase-equivalent dense
         # transmission: rel_volume = payload_bits / dense_bits, the same
         # convention the allreduce branch uses (the ring's (W-1)/W factor is

@@ -284,6 +284,16 @@ class DeepReduceConfig:
     # consecutive same-direction votes required before a move; any hold or
     # opposite vote resets the streak (anti-oscillation)
     ctrl_hysteresis: int = 2
+    # fitted machine profile (costmodel.MachineProfile JSON, written by
+    # `python -m deepreduce_tpu.telemetry calibrate RUN --out P.json`): the
+    # 'auto' selectors (rs_mode='auto', hier_ici/hier_dcn='auto') argmin
+    # over the profile's measured bandwidths/overheads instead of the
+    # static constants. None (default) keeps every selection byte-identical
+    # to the constants; a profile that agrees with the constants changes
+    # nothing (pinned by the jx-calib-reselect analysis rule). Requires an
+    # 'auto' selector to consume it — a fully explicit plan has nothing for
+    # the profile to re-select.
+    profile: Optional[str] = None
 
     # the documented enumerations (comments above + codecs/registry.py).
     # __post_init__ checks against these so a typo like
@@ -677,6 +687,27 @@ class DeepReduceConfig:
             from deepreduce_tpu.controller.ladder import Ladder
 
             Ladder.parse(self.ctrl_ladder)
+        # --- fitted machine profile: must have a selector to re-select ------
+        if self.profile is not None:
+            has_auto = (
+                self.rs_mode == "auto"
+                or self.hier_ici == "auto"
+                or self.hier_dcn == "auto"
+            )
+            if not has_auto:
+                raise ValueError(
+                    f"profile={self.profile!r} re-prices the 'auto' plan "
+                    "selection and would be silently ignored with every "
+                    "selector explicit — set rs_mode='auto' or "
+                    "hier_ici/hier_dcn='auto' (or drop profile)"
+                )
+            if self.ctrl:
+                raise ValueError(
+                    "profile with ctrl=True would fight the adaptive "
+                    "controller for the operating point — calibrate the "
+                    "construction-time plan (profile) or adapt at runtime "
+                    "(ctrl), not both"
+                )
 
     def fed_config(self):
         """The round-geometry view of the fed_* knobs (deferred import:

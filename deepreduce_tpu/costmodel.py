@@ -27,14 +27,170 @@ trace.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import math
-from typing import Dict, Optional
+import pathlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # 100 Mbps in bytes/s — the paper's federated uplink assumption, and the
 # default link every committed bench record uses.
 BW_100MBPS = 12.5e6
 
 RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "auto")
+
+
+# ---------------------------------------------------------------------------
+# Machine profiles — the calibrated counterpart of the static constants.
+#
+# Every estimator below accepts an optional ``profile=`` (a MachineProfile):
+# wherever a bandwidth/compute default would have come from a hardcoded
+# constant, the profile's fitted value is used instead. An explicitly passed
+# bw always wins over the profile, and profile=None reproduces the historical
+# constants byte-for-byte — so every committed BENCH_*.json stays replayable.
+# Profiles are fitted from a tracking run dir by `calibrate()` and serialized
+# as a schema-tagged JSON record with NO wall-clock fields, so a profile is
+# bitwise-replayable from a committed run dir.
+# ---------------------------------------------------------------------------
+
+PROFILE_SCHEMA = "deepreduce_tpu/machine-profile/v1"
+
+# the model parameters a profile carries; each is either "fitted" (recovered
+# from telemetry) or "fixed" (unidentifiable in that run — held at the
+# static constant and recorded as such)
+PROFILE_PARAMS = ("bw_dcn", "bw_ici", "t_enc", "t_dec", "compute_time")
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineProfile:
+    """Fitted (or static-default) machine parameters for the cost model.
+
+    bw_* are link bandwidths in bytes/s; t_enc_s / t_dec_s are one encode /
+    one decode in seconds (the same units the measurement rows use);
+    compute_time_s is the per-step backward compute available to hide wire
+    behind. `fitted` / `fixed` partition PROFILE_PARAMS by whether the run's
+    telemetry identified the parameter; `source` documents the fit inputs
+    (run name, measured step time, apportioned component seconds — and
+    deliberately no wall-clock timestamps, so the record is deterministic)."""
+
+    bw_dcn: float = BW_100MBPS
+    bw_ici: float = 1.25e9  # == BW_ICI_10GBPS (defined below)
+    t_enc_s: float = 0.0
+    t_dec_s: float = 0.0
+    compute_time_s: float = 0.0
+    fitted: Tuple[str, ...] = ()
+    fixed: Tuple[str, ...] = PROFILE_PARAMS
+    source: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_record(self) -> Dict[str, Any]:
+        return {
+            "schema": PROFILE_SCHEMA,
+            "bw_dcn_bytes_per_s": float(self.bw_dcn),
+            "bw_ici_bytes_per_s": float(self.bw_ici),
+            "t_enc_s": float(self.t_enc_s),
+            "t_dec_s": float(self.t_dec_s),
+            "compute_time_s": float(self.compute_time_s),
+            "fitted": list(self.fitted),
+            "fixed": list(self.fixed),
+            "source": dict(self.source),
+        }
+
+    @classmethod
+    def from_record(cls, rec: Dict[str, Any]) -> "MachineProfile":
+        validate_profile(rec)
+        return cls(
+            bw_dcn=float(rec["bw_dcn_bytes_per_s"]),
+            bw_ici=float(rec["bw_ici_bytes_per_s"]),
+            t_enc_s=float(rec["t_enc_s"]),
+            t_dec_s=float(rec["t_dec_s"]),
+            compute_time_s=float(rec["compute_time_s"]),
+            fitted=tuple(rec["fitted"]),
+            fixed=tuple(rec["fixed"]),
+            source=dict(rec.get("source", {})),
+        )
+
+    def save(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_record(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+
+def static_profile() -> MachineProfile:
+    """The profile that encodes exactly the static constants — by contract
+    it changes NO selector's pick (pinned by the jx-calib-reselect audit)."""
+    return MachineProfile()
+
+
+def validate_profile(rec: Any) -> None:
+    """Raise ValueError unless `rec` is a well-formed machine-profile record
+    (the schema the `telemetry calibrate` CLI emits and `load_profile`
+    accepts)."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"profile record must be a dict, got {type(rec).__name__}")
+    if rec.get("schema") != PROFILE_SCHEMA:
+        raise ValueError(
+            f"profile schema must be {PROFILE_SCHEMA!r}, got {rec.get('schema')!r}"
+        )
+    for key, positive in (
+        ("bw_dcn_bytes_per_s", True),
+        ("bw_ici_bytes_per_s", True),
+        ("t_enc_s", False),
+        ("t_dec_s", False),
+        ("compute_time_s", False),
+    ):
+        v = rec.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            raise ValueError(f"profile field {key!r} must be a number, got {v!r}")
+        if not math.isfinite(float(v)):
+            raise ValueError(f"profile field {key!r} must be finite, got {v!r}")
+        if positive and float(v) <= 0:
+            raise ValueError(f"profile field {key!r} must be > 0, got {v!r}")
+        if not positive and float(v) < 0:
+            raise ValueError(f"profile field {key!r} must be >= 0, got {v!r}")
+    fitted = rec.get("fitted")
+    fixed = rec.get("fixed")
+    for name, val in (("fitted", fitted), ("fixed", fixed)):
+        if not isinstance(val, (list, tuple)) or not all(
+            isinstance(p, str) for p in val
+        ):
+            raise ValueError(f"profile field {name!r} must be a list of strings")
+    both = list(fitted) + list(fixed)
+    if sorted(both) != sorted(PROFILE_PARAMS):
+        raise ValueError(
+            "profile fitted+fixed must partition "
+            f"{sorted(PROFILE_PARAMS)}, got fitted={list(fitted)} "
+            f"fixed={list(fixed)}"
+        )
+    if "source" in rec and not isinstance(rec["source"], dict):
+        raise ValueError("profile field 'source' must be a dict")
+
+
+def load_profile(path) -> MachineProfile:
+    """Read + schema-validate a profile.json written by `MachineProfile.save`
+    (or the `telemetry calibrate --out` CLI). Raises ValueError on schema
+    violations, OSError on unreadable paths."""
+    with open(path) as f:
+        rec = json.load(f)
+    return MachineProfile.from_record(rec)
+
+
+def _bw_dcn(bw: Optional[float], profile: Optional[MachineProfile]) -> float:
+    """Resolve a DCN bandwidth: explicit bw > profile > static constant."""
+    if bw is not None:
+        return bw
+    if profile is not None:
+        return profile.bw_dcn
+    return BW_100MBPS
+
+
+def _bw_ici(bw: Optional[float], profile: Optional[MachineProfile]) -> float:
+    """Resolve an ICI bandwidth: explicit bw > profile > static constant."""
+    if bw is not None:
+        return bw
+    if profile is not None:
+        return profile.bw_ici
+    return BW_ICI_10GBPS
 
 
 def dense_measurement(d: int) -> Dict[str, float]:
@@ -47,11 +203,18 @@ def dense_measurement(d: int) -> Dict[str, float]:
     }
 
 
-def exchange_time(m: Dict[str, float], bw: float = BW_100MBPS) -> float:
+def exchange_time(
+    m: Dict[str, float],
+    bw: Optional[float] = None,
+    *,
+    profile: Optional[MachineProfile] = None,
+) -> float:
     """Flat per-worker step-time model: injection bytes over the link plus
     one encode and one decode. Unchanged from the pre-r11 bench.py inline
-    form; every historical BENCH_*.json speedup is computed with this."""
-    return m["payload_bytes"] / bw + m["t_encode_s"] + m["t_decode_s"]
+    form; every historical BENCH_*.json speedup is computed with this.
+    ``profile`` substitutes a calibrated link bandwidth for the 100 Mbps
+    constant (an explicit bw still wins); None keeps the historical model."""
+    return m["payload_bytes"] / _bw_dcn(bw, profile) + m["t_encode_s"] + m["t_decode_s"]
 
 
 # ---------------------------------------------------------------------------
@@ -82,14 +245,18 @@ def reduce_scatter_time(buffer_bytes: float, W: int, bw: float = BW_100MBPS) -> 
 
 
 def fused_step_time(
-    m: Dict[str, float], W: int, bw: float = BW_100MBPS
+    m: Dict[str, float],
+    W: int,
+    bw: Optional[float] = None,
+    *,
+    profile: Optional[MachineProfile] = None,
 ) -> float:
     """W-aware model of the fused gather-then-decode exchange: one encode,
     an allgather of the per-worker payload, then W payload decodes (own +
     W-1 remote). `m` is a flat measurement row (t_decode_s = one decode)."""
     return (
         m["t_encode_s"]
-        + allgather_time(m["payload_bytes"], W, bw)
+        + allgather_time(m["payload_bytes"], W, _bw_dcn(bw, profile))
         + W * m["t_decode_s"]
     )
 
@@ -97,8 +264,10 @@ def fused_step_time(
 def overlapped_step_time(
     m: Dict[str, float],
     W: int,
-    bw: float = BW_100MBPS,
-    compute_time: float = 0.0,
+    bw: Optional[float] = None,
+    compute_time: Optional[float] = None,
+    *,
+    profile: Optional[MachineProfile] = None,
 ) -> float:
     """Step-time model of the backprop-overlapped streaming schedule
     (``cfg.stream_exchange``): each bucket's allgather dispatches while
@@ -108,8 +277,11 @@ def overlapped_step_time(
     encode and the W decodes still pay their serial cost. With
     ``compute_time=0`` this is exactly `fused_step_time` (nothing to hide
     behind), so the streamed model can never exceed the r09 pipelined
-    schedule's."""
-    wire = allgather_time(m["payload_bytes"], W, bw)
+    schedule's. ``compute_time=None`` takes the profile's fitted per-step
+    compute when one is given (else 0.0 — the historical model)."""
+    if compute_time is None:
+        compute_time = profile.compute_time_s if profile is not None else 0.0
+    wire = allgather_time(m["payload_bytes"], W, _bw_dcn(bw, profile))
     exposed = max(0.0, wire - max(0.0, compute_time))
     return m["t_encode_s"] + exposed + W * m["t_decode_s"]
 
@@ -117,13 +289,17 @@ def overlapped_step_time(
 def overlap_fraction(
     m: Dict[str, float],
     W: int,
-    bw: float = BW_100MBPS,
-    compute_time: float = 0.0,
+    bw: Optional[float] = None,
+    compute_time: Optional[float] = None,
+    *,
+    profile: Optional[MachineProfile] = None,
 ) -> float:
     """Fraction of the allgather wire time hidden behind backward compute
     under the streaming schedule — the modeled counterpart of the measured
     `trace --overlap` report. 1.0 when there is no wire to expose."""
-    wire = allgather_time(m["payload_bytes"], W, bw)
+    if compute_time is None:
+        compute_time = profile.compute_time_s if profile is not None else 0.0
+    wire = allgather_time(m["payload_bytes"], W, _bw_dcn(bw, profile))
     if wire <= 0.0:
         return 1.0
     return min(wire, max(0.0, compute_time)) / wire
@@ -286,15 +462,18 @@ def rs_step_time(
     ratio: float,
     *,
     t_compute_s: float = 0.0,
-    bw: float = BW_100MBPS,
+    bw: Optional[float] = None,
     compute_time: float = 0.0,
+    profile: Optional[MachineProfile] = None,
     **kw,
 ) -> float:
     """W-aware modeled step time of one in-collective route: ring wire time
     of each collective it issues plus its (once-per-worker) compute.
     ``compute_time`` is backward-pass compute available to hide wire behind
     (the streaming-overlap discipline); 0 keeps the historical serialized
-    model byte-for-byte."""
+    model byte-for-byte. ``profile`` supplies a calibrated link bandwidth
+    when no explicit bw is given."""
+    bw = _bw_dcn(bw, profile)
     wire = 0.0
     for prim, size in rs_wire_bytes(mode, d, W, ratio, **kw).items():
         wire += _RING_TIME[prim](size, W, bw)
@@ -318,9 +497,10 @@ def select_rs_mode(
     block: int = 256,
     rows: int = 5,
     cols: int = 0,
-    bw: float = BW_100MBPS,
+    bw: Optional[float] = None,
     modes: Optional[tuple] = None,
     compute_time: float = 0.0,
+    profile: Optional[MachineProfile] = None,
 ) -> str:
     """Resolve ``rs_mode="auto"`` at construction time: argmin of the
     wire-only W-aware model over the concrete routes. At the 100 Mbps
@@ -329,7 +509,11 @@ def select_rs_mode(
     deterministic from (d, W, ratio) and static config alone.
     ``compute_time`` (hideable backward compute, see `overlapped_step_time`)
     threads through to each candidate's `rs_step_time`; the default 0
-    keeps the historical selection."""
+    keeps the historical selection. ``profile`` prices the candidates at a
+    calibrated bandwidth — note every rs route's time is wire-only and
+    scales as 1/bw, so a bandwidth-only profile can never flip this argmin
+    (that is a property of the model, not a bug; the hierarchical planner
+    is where fitted encode/decode costs change picks)."""
     candidates = modes or ("sparse", "adaptive", "quantized", "sketch")
     best, best_t = None, float("inf")
     for mode in candidates:
@@ -337,7 +521,7 @@ def select_rs_mode(
             mode, d, W, ratio,
             headroom=headroom, out_headroom=out_headroom,
             block=block, rows=rows, cols=cols, bw=bw,
-            compute_time=compute_time,
+            compute_time=compute_time, profile=profile,
         )
         if t < best_t:
             best, best_t = mode, t
@@ -377,11 +561,12 @@ def qar_wire_bytes_per_worker(d: int, W: int, block: int = 512) -> float:
 
 
 def hier_ici_time(
-    leg: str, d: int, per_slice: int, bw_ici: float = BW_ICI_10GBPS,
-    *, block: int = 512,
+    leg: str, d: int, per_slice: int, bw_ici: Optional[float] = None,
+    *, block: int = 512, profile: Optional[MachineProfile] = None,
 ) -> float:
     """Modeled ICI-leg time: dense f32 psum or int8 quantized allreduce
     over the `per_slice` devices of one slice."""
+    bw_ici = _bw_ici(bw_ici, profile)
     if per_slice <= 1:
         return 0.0
     if leg == "dense":
@@ -396,11 +581,12 @@ def hier_dcn_time(
     d: int,
     n_slices: int,
     ratio: float,
-    bw_dcn: float = BW_100MBPS,
+    bw_dcn: Optional[float] = None,
     *,
     measurement: Optional[Dict[str, float]] = None,
     t_compute_s: float = 0.0,
     compute_time: float = 0.0,
+    profile: Optional[MachineProfile] = None,
     **kw,
 ) -> float:
     """Modeled DCN-leg time with `n_slices` workers on the scarce link.
@@ -414,12 +600,16 @@ def hier_dcn_time(
     hideable backward compute (the streaming overlap, `overlapped_step_
     time`): it shaves every leg's wire before the formulas above, so the
     planner can price what streaming buys on the scarce link; 0 keeps the
-    historical model."""
+    historical model. A ``profile`` supplies its calibrated bandwidth AND
+    fills the default fused/bucketed measurement row with the fitted
+    encode/decode seconds — the one place a fitted profile can genuinely
+    flip a plan (the rs legs are wire-only and bandwidth-scale-invariant)."""
+    bw_dcn = _bw_dcn(bw_dcn, profile)
     if leg in ("fused", "bucketed"):
         m = measurement or {
             "payload_bytes": 8.0 * max(1, int(d * ratio)),
-            "t_encode_s": 0.0,
-            "t_decode_s": 0.0,
+            "t_encode_s": profile.t_enc_s if profile is not None else 0.0,
+            "t_decode_s": profile.t_dec_s if profile is not None else 0.0,
         }
         wire = allgather_time(m["payload_bytes"], n_slices, bw_dcn)
         wire = max(0.0, wire - max(0.0, compute_time))
@@ -440,21 +630,24 @@ def hier_step_time(
     per_slice: int,
     ratio: float,
     *,
-    bw_ici: float = BW_ICI_10GBPS,
-    bw_dcn: float = BW_100MBPS,
+    bw_ici: Optional[float] = None,
+    bw_dcn: Optional[float] = None,
     ici_block: int = 512,
     measurement: Optional[Dict[str, float]] = None,
     t_compute_s: float = 0.0,
     compute_time: float = 0.0,
+    profile: Optional[MachineProfile] = None,
     **kw,
 ) -> float:
     """Modeled step time of one (ici, dcn) plan: serialized two-leg sum.
     ``compute_time`` (hideable backward compute) applies to the DCN leg
     only — the ICI leg runs after the slice mean and cannot stream."""
-    return hier_ici_time(ici, d, per_slice, bw_ici, block=ici_block) + hier_dcn_time(
+    return hier_ici_time(
+        ici, d, per_slice, bw_ici, block=ici_block, profile=profile
+    ) + hier_dcn_time(
         dcn, d, n_slices, ratio, bw_dcn,
         measurement=measurement, t_compute_s=t_compute_s,
-        compute_time=compute_time, **kw,
+        compute_time=compute_time, profile=profile, **kw,
     )
 
 
@@ -463,8 +656,8 @@ def select_hier_plan(
     n_slices: int,
     per_slice: int,
     ratio: float,
-    bw_ici: float = BW_ICI_10GBPS,
-    bw_dcn: float = BW_100MBPS,
+    bw_ici: Optional[float] = None,
+    bw_dcn: Optional[float] = None,
     *,
     ici_block: int = 512,
     ici_legs: Optional[tuple] = None,
@@ -472,6 +665,7 @@ def select_hier_plan(
     measurements: Optional[Dict[str, Dict[str, float]]] = None,
     compute: Optional[Dict[str, float]] = None,
     compute_time: float = 0.0,
+    profile: Optional[MachineProfile] = None,
     **kw,
 ) -> Dict:
     """Construction-time auto-planner: argmin of `hier_step_time` over
@@ -481,7 +675,11 @@ def select_hier_plan(
     `select_rs_mode`; bench.py --hier-sweep optionally supplies measured
     codec rows (`measurements[dcn_leg]` -> flat measurement dict) and
     per-route compute (`compute[dcn_leg]` seconds) so its report and the
-    planner argmin over exactly the same numbers.
+    planner argmin over exactly the same numbers. A ``profile`` re-prices
+    every candidate under the calibrated bandwidths and charges the fitted
+    encode/decode seconds on the fused/bucketed legs (explicit bw_* and
+    `measurements` rows still win) — this is the selector a fitted profile
+    can actually flip.
 
     Returns {"ici", "dcn", "modeled_step_s", "table"} where table maps
     "ici+dcn" -> modeled seconds for every candidate pair."""
@@ -497,7 +695,7 @@ def select_hier_plan(
                 ici, dcn, d, n_slices, per_slice, ratio,
                 bw_ici=bw_ici, bw_dcn=bw_dcn, ici_block=ici_block,
                 measurement=m, t_compute_s=tc, compute_time=compute_time,
-                **kw,
+                profile=profile, **kw,
             )
             table[f"{ici}+{dcn}"] = t
             if best is None or t < table[f"{best[0]}+{best[1]}"]:
@@ -508,3 +706,279 @@ def select_hier_plan(
         "modeled_step_s": table[f"{best[0]}+{best[1]}"],
         "table": table,
     }
+
+
+# ---------------------------------------------------------------------------
+# Calibration: fit a MachineProfile from a tracking run directory.
+#
+# The fit joins three telemetry artifacts every `--telemetry` run writes:
+#   trace.json    — host-side span X-events. Spans wrapping traced code fire
+#                   ONCE PER TRACE (their durations are trace-time, inflated
+#                   ~10x over a compiled step), while the driver's
+#                   `train/step` span fires every step with real wall time.
+#   summary.json  — the on-device accumulators' derived rows, including the
+#                   per-axis wire counters `dcn_bytes_per_step` /
+#                   `ici_bytes_per_step`.
+#   metrics.jsonl — per-step records; consecutive `ts` deltas are the step-
+#                   time fallback when the run has no train/step spans.
+#
+# The decomposition is share-based: per-span-name SELF time (container time
+# minus children — streaming runs nest the exchange spans inside
+# train/forward_backward) is bucketed into encode / decode / DCN-wire /
+# ICI-wire / compute / other categories, and each category's share of the
+# trace-time pool is apportioned against the measured (warmup-dropped) mean
+# step time. The identifiability assumption this rests on: RELATIVE span
+# durations at trace time track relative durations at run time — trace-time
+# inflation cancels in the shares. Predicted step time is the sum of the
+# apportioned components, so the fit reproduces the measured step time
+# exactly by construction; the CLI tolerance gate checks the round trip
+# through the model formulas (bw inverted, then wire recomputed).
+#
+# Parameters a run cannot identify (no decode spans, zero ICI bytes, ...)
+# are held at the static constants and listed under `fixed` — a profile is
+# honest about what it measured.
+# ---------------------------------------------------------------------------
+
+# leaf spans charged to each model parameter. Everything not listed (and
+# not excluded) lands in the residual "other" component, which calibrate()
+# carries through so the decomposition stays exact.
+CAL_ENCODE_SPANS = frozenset({
+    "exchange/encode", "exchange/pack",
+    "sparse_rs/select", "sparse_rs/quantize", "sparse_rs/adaptive-quantize",
+    "sparse_rs/sketch",
+})
+CAL_DECODE_SPANS = frozenset({
+    "exchange/decode", "sparse_rs/unsketch", "sparse_rs/reduce",
+})
+CAL_WIRE_DCN_SPANS = frozenset({
+    "exchange/allgather", "exchange/ring", "exchange/qar",
+    "sparse_rs/route", "sparse_rs/allgather", "sparse_rs/psum",
+    "sparse_rs/reduce-scatter", "sparse_rs/norm-pmax",
+})
+CAL_WIRE_ICI_SPANS = frozenset({"exchange/ici"})
+CAL_COMPUTE_SPANS = frozenset({"train/forward_backward"})
+# spans that are not per-step work at all: the driver's step timer (it is
+# the measurement target, not a component) and the one-time program build
+CAL_EXCLUDED_SPANS = frozenset({"train/step", "train/build"})
+
+
+def drop_warmup(xs: Sequence[float], k: float = 4.0) -> List[float]:
+    """Strip the leading run of compile-skewed samples: with the median of
+    the trailing half as the steady-state scale, drop leading samples more
+    than `k` times it. Robust to MULTIPLE warmup steps (a telemetry run
+    compiles once per distinct program — streaming runs show two) where a
+    drop-first-only policy is not. Always keeps at least one sample."""
+    xs = list(xs)
+    if len(xs) <= 1:
+        return xs
+    tail = sorted(xs[len(xs) // 2:])
+    ref = tail[len(tail) // 2]
+    i = 0
+    while i < len(xs) - 1 and xs[i] > k * ref:
+        i += 1
+    return xs[i:]
+
+
+def span_self_times(events) -> Dict[str, float]:
+    """Per-span-name SELF time in seconds from Chrome-trace "X" events:
+    each span's duration minus its direct children's, computed with a
+    per-(pid, tid) interval stack — so a container like
+    train/forward_backward is not double-charged for the exchange spans a
+    streaming run nests inside it."""
+    by_tid: Dict[Any, List[Tuple[float, float, str]]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        ts, dur = e.get("ts"), e.get("dur")
+        if not isinstance(ts, (int, float)) or not isinstance(dur, (int, float)):
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        by_tid.setdefault(key, []).append(
+            (float(ts), float(dur), str(e.get("name", "")))
+        )
+    self_us: Dict[str, float] = {}
+    for evs in by_tid.values():
+        # parents sort before children: earlier start first, longer first on
+        # ties (a child can share its parent's start timestamp)
+        evs.sort(key=lambda t: (t[0], -t[1]))
+        stack: List[Tuple[float, str]] = []  # (end_ts, name)
+        for ts, dur, name in evs:
+            while stack and ts >= stack[-1][0]:
+                stack.pop()
+            self_us[name] = self_us.get(name, 0.0) + dur
+            if stack:
+                parent = stack[-1][1]
+                self_us[parent] = self_us.get(parent, 0.0) - dur
+            stack.append((ts + dur, name))
+    return {name: us * 1e-6 for name, us in self_us.items()}
+
+
+def _read_json(path: pathlib.Path) -> Dict[str, Any]:
+    if not path.exists():
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def calibrate(
+    run_dir, *, include_warmup: bool = False, warmup_k: float = 4.0
+) -> MachineProfile:
+    """Fit a MachineProfile from one tracking run dir (see the section
+    comment above for the decomposition). Deterministic: the profile is a
+    pure function of the run dir's committed files — no wall clock enters
+    the record, so re-running on a committed run dir is bitwise stable.
+    Raises ValueError when the run lacks the telemetry the fit needs."""
+    run = pathlib.Path(run_dir)
+    cfg_rec = _read_json(run / "config.json")
+    if not cfg_rec:
+        raise ValueError(f"{run}: no config.json — not a tracking run dir")
+    config = cfg_rec.get("config", {}) or {}
+    W = int(config.get("workers", cfg_rec.get("workers", 1)) or 1)
+    trace = _read_json(run / "trace.json")
+    events = [e for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+    if not events:
+        raise ValueError(
+            f"{run}: no span trace (trace.json) — re-run with --telemetry "
+            "to record the spans the fit decomposes"
+        )
+    self_s = span_self_times(events)
+
+    # --- measured step time: train/step spans, else metrics.jsonl ts ---- #
+    step_durs = sorted(
+        (float(e["ts"]), float(e["dur"]) * 1e-6)
+        for e in events
+        if e.get("name") == "train/step"
+        and isinstance(e.get("ts"), (int, float))
+        and isinstance(e.get("dur"), (int, float))
+    )
+    samples = [dur for _, dur in step_durs]
+    step_source = "train/step spans"
+    if not samples:
+        ts: List[float] = []
+        mpath = run / "metrics.jsonl"
+        if mpath.exists():
+            with open(mpath) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rec = json.loads(line)
+                        if isinstance(rec.get("ts"), (int, float)):
+                            ts.append(float(rec["ts"]))
+        samples = [b - a for a, b in zip(ts, ts[1:]) if b >= a]
+        step_source = "metrics.jsonl ts deltas"
+    if not samples:
+        raise ValueError(
+            f"{run}: no step-time samples (no train/step spans and no "
+            "metrics.jsonl timestamps)"
+        )
+    n_total = len(samples)
+    kept = samples if include_warmup else drop_warmup(samples, k=warmup_k)
+    T = sum(kept) / len(kept)
+    if T <= 0.0:
+        raise ValueError(f"{run}: measured mean step time is not positive")
+
+    # --- trace-time shares -> apportioned per-step component seconds ---- #
+    pool = {
+        name: s
+        for name, s in self_s.items()
+        if name not in CAL_EXCLUDED_SPANS and s > 0.0
+    }
+    total_tr = sum(pool.values())
+
+    def _cat(names) -> float:
+        return sum(s for n, s in pool.items() if n in names)
+
+    enc_tr = _cat(CAL_ENCODE_SPANS)
+    dec_tr = _cat(CAL_DECODE_SPANS)
+    wdcn_tr = _cat(CAL_WIRE_DCN_SPANS)
+    wici_tr = _cat(CAL_WIRE_ICI_SPANS)
+    comp_tr = _cat(CAL_COMPUTE_SPANS)
+    other_tr = total_tr - (enc_tr + dec_tr + wdcn_tr + wici_tr + comp_tr)
+    scale = T / total_tr if total_tr > 0.0 else 0.0
+    enc_s, dec_s = enc_tr * scale, dec_tr * scale
+    wdcn_s, wici_s = wdcn_tr * scale, wici_tr * scale
+    comp_s, other_s = comp_tr * scale, other_tr * scale
+
+    # --- wire counters (per-worker injection bytes per step) ------------ #
+    telem = _read_json(run / "summary.json").get("telemetry") or {}
+    dcn_bytes = float(telem.get("dcn_bytes_per_step", 0.0) or 0.0)
+    ici_bytes = float(telem.get("ici_bytes_per_step", 0.0) or 0.0)
+
+    # --- invert the model where identifiable, hold constants where not -- #
+    fitted: List[str] = []
+    fixed: List[str] = []
+    t_enc = 0.0
+    if enc_tr > 0.0:
+        t_enc = enc_s
+        fitted.append("t_enc")
+    else:
+        fixed.append("t_enc")
+    t_dec = 0.0
+    if dec_tr > 0.0:
+        # the model charges W decodes per step (own + W-1 remote rows)
+        t_dec = dec_s / W
+        fitted.append("t_dec")
+    else:
+        fixed.append("t_dec")
+    bw_dcn = BW_100MBPS
+    if wdcn_tr > 0.0 and dcn_bytes > 0.0 and W > 1:
+        # allgather ring: wire_s = (W-1) * injection_bytes / bw
+        bw_dcn = (W - 1) * dcn_bytes / wdcn_s
+        fitted.append("bw_dcn")
+    else:
+        fixed.append("bw_dcn")
+    bw_ici = BW_ICI_10GBPS
+    if wici_tr > 0.0 and ici_bytes > 0.0:
+        bw_ici = ici_bytes / wici_s
+        fitted.append("bw_ici")
+    else:
+        fixed.append("bw_ici")
+    compute_time = 0.0
+    if comp_tr > 0.0:
+        compute_time = comp_s
+        fitted.append("compute_time")
+    else:
+        fixed.append("compute_time")
+
+    # round trip through the model formulas: fitted bandwidths re-price the
+    # observed bytes, fixed components keep their apportioned seconds
+    wire_dcn_pred = (
+        allgather_time(dcn_bytes, W, bw_dcn) if "bw_dcn" in fitted else wdcn_s
+    )
+    wire_ici_pred = ici_bytes / bw_ici if "bw_ici" in fitted else wici_s
+    predicted = (
+        t_enc + wire_dcn_pred + wire_ici_pred + W * t_dec + compute_time + other_s
+    )
+    cfg_digest = hashlib.sha256(
+        json.dumps(config, sort_keys=True).encode()
+    ).hexdigest()[:16]
+    source = {
+        "run": run.name,
+        "config_digest": cfg_digest,
+        "workers": W,
+        "step_time_source": step_source,
+        "include_warmup": bool(include_warmup),
+        "steps_total": n_total,
+        "steps_measured": len(kept),
+        "warmup_dropped": n_total - len(kept),
+        "measured_step_s": T,
+        "predicted_step_s": predicted,
+        "encode_s": enc_s,
+        "decode_s": dec_s,
+        "wire_dcn_s": wdcn_s,
+        "wire_ici_s": wici_s,
+        "compute_s": comp_s,
+        "other_s": other_s,
+        "dcn_bytes_per_step": dcn_bytes,
+        "ici_bytes_per_step": ici_bytes,
+    }
+    return MachineProfile(
+        bw_dcn=bw_dcn,
+        bw_ici=bw_ici,
+        t_enc_s=t_enc,
+        t_dec_s=t_dec,
+        compute_time_s=compute_time,
+        fitted=tuple(fitted),
+        fixed=tuple(fixed),
+        source=source,
+    )

@@ -66,7 +66,10 @@ def _total_elems(grads_like: Any) -> int:
     )
 
 
-def _cfg_dcn_leg(cfg: DeepReduceConfig, d: int, n_slices: Optional[int]) -> Optional[str]:
+def _cfg_dcn_leg(
+    cfg: DeepReduceConfig, d: int, n_slices: Optional[int],
+    profile: Optional[costmodel.MachineProfile] = None,
+) -> Optional[str]:
     """The cost-model leg name of the DCN route this config describes, or
     None when the route has no model row (allreduce / qar across DCN)."""
     if cfg.communicator == "sparse_rs":
@@ -78,7 +81,7 @@ def _cfg_dcn_leg(cfg: DeepReduceConfig, d: int, n_slices: Optional[int]) -> Opti
             d, n_slices, cfg.compress_ratio,
             headroom=cfg.rs_headroom, out_headroom=cfg.rs_out_headroom,
             block=cfg.rs_block_size, rows=cfg.rs_sketch_rows,
-            cols=cfg.rs_sketch_cols,
+            cols=cfg.rs_sketch_cols, profile=profile,
         )
     if cfg.communicator == "allgather":
         return "bucketed" if cfg.bucket_bytes else "fused"
@@ -107,12 +110,16 @@ class HierarchicalExchanger:
     def __init__(self, grads_like: Any, cfg: DeepReduceConfig, *,
                  dcn_axis: str = "dcn", ici_axis: str = "ici",
                  num_slices: Optional[int] = None,
-                 per_slice: Optional[int] = None):
+                 per_slice: Optional[int] = None,
+                 profile: Optional[costmodel.MachineProfile] = None):
         self.cfg = cfg
         self.ici_axis = ici_axis
         self.dcn_axis = dcn_axis
         self.num_slices = num_slices
         self.per_slice = per_slice
+        if profile is None and cfg.profile is not None:
+            profile = costmodel.load_profile(cfg.profile)
+        self.profile = profile
         d = _total_elems(grads_like)
         self.ici_leg = cfg.hier_ici
         self.plan: Optional[Dict] = None
@@ -133,7 +140,7 @@ class HierarchicalExchanger:
                     "sparse", "adaptive", "quantized", "sketch",
                 )
             else:
-                leg = _cfg_dcn_leg(cfg, d, num_slices)
+                leg = _cfg_dcn_leg(cfg, d, num_slices, profile)
                 if leg is None:
                     raise ValueError(
                         "hier_ici='auto' needs a cost-modelable DCN leg to "
@@ -149,7 +156,7 @@ class HierarchicalExchanger:
                 dcn_legs=dcn_legs,
                 headroom=cfg.rs_headroom, out_headroom=cfg.rs_out_headroom,
                 block=cfg.rs_block_size, rows=cfg.rs_sketch_rows,
-                cols=cfg.rs_sketch_cols,
+                cols=cfg.rs_sketch_cols, profile=profile,
             )
             if cfg.hier_ici == "auto":
                 self.ici_leg = self.plan["ici"]
@@ -166,7 +173,8 @@ class HierarchicalExchanger:
                     )
         self.inner_cfg = inner_cfg
         self.exchanger = GradientExchanger(
-            grads_like, inner_cfg, axis_name=dcn_axis, num_workers=num_slices
+            grads_like, inner_cfg, axis_name=dcn_axis, num_workers=num_slices,
+            profile=profile,
         )
 
     # --- surface the GradientExchanger attributes drivers consume -------- #
@@ -214,12 +222,16 @@ class HierarchicalExchanger:
             if self.ici_leg == "qar":
                 from jax.flatten_util import ravel_pytree
 
-                flat, unravel = ravel_pytree(grads)
-                d = flat.shape[0]
-                n = qar.pad_len(d, n_ici, self.cfg.bucket_size)
-                padded = flat.astype(jnp.float32)
-                if n > d:
-                    padded = jnp.zeros((n,), jnp.float32).at[:d].set(padded)
+                # encode/decode sub-spans inside the ici leg: calibrate()
+                # charges them to t_enc/t_dec (self-time keeps the wire
+                # share in exchange/ici itself)
+                with spans.span("exchange/encode"):
+                    flat, unravel = ravel_pytree(grads)
+                    d = flat.shape[0]
+                    n = qar.pad_len(d, n_ici, self.cfg.bucket_size)
+                    padded = flat.astype(jnp.float32)
+                    if n > d:
+                        padded = jnp.zeros((n,), jnp.float32).at[:d].set(padded)
                 kq = key if key is not None else jax.random.PRNGKey(step)
                 mean = qar.quantized_allreduce(
                     padded, self.ici_axis, n_ici,
@@ -228,7 +240,8 @@ class HierarchicalExchanger:
                     bucket_size=self.cfg.bucket_size,
                     use_pallas=self.cfg.use_pallas,
                 )
-                slice_mean = unravel(mean[:d].astype(flat.dtype))
+                with spans.span("exchange/decode"):
+                    slice_mean = unravel(mean[:d].astype(flat.dtype))
                 ici_bits += qar.wire_bits_per_worker(d, n_ici, self.cfg.bucket_size)
             else:
                 slice_mean = jax.tree_util.tree_map(

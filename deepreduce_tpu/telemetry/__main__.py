@@ -34,10 +34,29 @@ offline consumer of tracking.py run directories.
                              ``--overlap-threshold`` (the CI gate that
                              backprop-overlapped dispatch actually
                              happened).
+- ``calibrate RUN [--out F]``
+                             fit a MachineProfile from the run's telemetry
+                             (costmodel.calibrate): span self-times joined
+                             with the per-axis wire counters, warmup
+                             dropped. Emits a schema-validated profile
+                             record — no wall clock in it, so a committed
+                             run dir replays bitwise. Exits 1 when the
+                             fitted model's predicted step time misses the
+                             measured one by more than ``--tol``.
+- ``compare --profile P --against BENCH.json``
+                             re-price a committed bench claim under a
+                             fitted machine profile: re-run the plan
+                             selection with and without the profile and
+                             report each sweep point where the static pick
+                             and the calibrated pick disagree (and what
+                             the static pick costs under the fitted
+                             model). Informational — exits 0.
 
-Runs with telemetry off get a clean "telemetry was off" notice instead of
-partial output. RUN may be a run directory or a tracking root (latest run
-is picked). Exit codes: 0 ok, 1 flagged regression, 2 usage/data error.
+Step-time statistics drop compile-dominated warmup intervals by default
+(``--include-warmup`` keeps them). Runs with telemetry off get a clean
+"telemetry was off" notice instead of partial output. RUN may be a run
+directory or a tracking root (latest run is picked). Exit codes: 0 ok,
+1 flagged regression, 2 usage/data error.
 """
 
 from __future__ import annotations
@@ -47,6 +66,8 @@ import json
 import pathlib
 import sys
 from typing import Any, Dict, List, Optional
+
+from deepreduce_tpu import costmodel
 
 
 def _fail(msg: str) -> int:
@@ -116,12 +137,20 @@ def _series(hist: List[Dict[str, Any]], key: str) -> List[float]:
     return [float(r[key]) for r in hist if isinstance(r.get(key), (int, float))]
 
 
-def _step_times(hist: List[Dict[str, Any]]) -> List[float]:
-    """Per-step wall time from consecutive metrics.jsonl timestamps. The
-    first interval (compile) is dropped when there are enough samples."""
+def _step_times(
+    hist: List[Dict[str, Any]], include_warmup: bool = False
+) -> List[float]:
+    """Per-step wall time from consecutive metrics.jsonl timestamps.
+    Compile-dominated warmup intervals are dropped by default via
+    costmodel.drop_warmup — robust to MULTIPLE compiled programs per run
+    (a streaming run compiles two), where the old drop-first-only policy
+    let the second warmup step skew p50/p99 and the calibration fit.
+    `--include-warmup` keeps every interval."""
     ts = _series(hist, "ts")
     dt = [b - a for a, b in zip(ts, ts[1:]) if b >= a]
-    return dt[1:] if len(dt) > 2 else dt
+    if include_warmup or len(dt) <= 2:
+        return dt
+    return costmodel.drop_warmup(dt)
 
 
 def _percentile(xs: List[float], q: float) -> float:
@@ -154,7 +183,9 @@ def _fmt_dist(d: Dict[str, float], unit: str = "") -> str:
     )
 
 
-def _run_report(run: pathlib.Path) -> Dict[str, Any]:
+def _run_report(
+    run: pathlib.Path, include_warmup: bool = False
+) -> Dict[str, Any]:
     cfg = _load_json(run / "config.json")
     summ = _load_json(run / "summary.json")
     hist = _history(run)
@@ -167,7 +198,7 @@ def _run_report(run: pathlib.Path) -> Dict[str, Any]:
         "loss_first": losses[0] if losses else None,
         "loss_last": losses[-1] if losses else None,
         "rel_volume": _dist(_series(hist, "rel_volume")),
-        "step_time_s": _dist(_step_times(hist)),
+        "step_time_s": _dist(_step_times(hist, include_warmup)),
     }
     telem = summ.get("telemetry")
     if isinstance(telem, dict):
@@ -256,7 +287,7 @@ def cmd_summary(args) -> int:
     run = _resolve_run(args.run)
     if run is None:
         return _fail(f"no run directory under {args.run!r} (need config.json)")
-    rep = _run_report(run)
+    rep = _run_report(run, args.include_warmup)
     if args.json:
         print(json.dumps(rep, indent=2))
         return 0
@@ -380,11 +411,108 @@ def _compare_ctrl(run_a, rep_a, run_b, rep_b) -> int:
     return 0
 
 
+def _profile_points(detail: Dict[str, Any]) -> Optional[List[Dict[str, Any]]]:
+    """Sweep points a machine profile can re-price. BENCH_CALIB records
+    carry an explicit `detail.points` list; single-point hier records
+    (BENCH_HIER_r12) carry the plan shape at the top of `detail`."""
+    pts = detail.get("points")
+    if isinstance(pts, list) and pts:
+        return [p for p in pts if isinstance(p, dict)]
+    if {"d", "ratio", "n_slices", "per_slice"} <= set(detail):
+        return [detail]
+    return None
+
+
+def _compare_profile(args) -> int:
+    """`compare --profile P --against BENCH.json`: re-price a committed
+    bench claim under a fitted machine profile. For each hier-shaped sweep
+    point the static `select_hier_plan` pick and the profile-driven pick
+    are compared; when they disagree, the static pick is also priced under
+    the fitted model to show what the constants would have cost on this
+    machine. rs-shaped points (`workers` instead of slices) get the same
+    treatment through `select_rs_mode` — whose argmin is bandwidth-scale-
+    invariant, so only the absolute times move. Informational: exits 0."""
+    try:
+        prof = costmodel.load_profile(args.profile)
+    except (OSError, ValueError) as e:
+        return _fail(f"cannot load profile {args.profile!r}: {e}")
+    bench = _load_json(pathlib.Path(args.against))
+    if not bench:
+        return _fail(f"cannot read bench record {args.against!r}")
+    detail = bench.get("detail", {})
+    points = _profile_points(detail if isinstance(detail, dict) else {})
+    if points is None:
+        return _fail(
+            f"{args.against!r} has no profile-repriceable sweep points "
+            "(need detail.points, or d/ratio/n_slices/per_slice in detail)"
+        )
+    print(f"re-pricing {args.against} under profile {args.profile}")
+    print(
+        f"  profile: bw_dcn {prof.bw_dcn:.4g} B/s  bw_ici {prof.bw_ici:.4g} "
+        f"B/s  t_enc {prof.t_enc_s:.4g}s  t_dec {prof.t_dec_s:.4g}s  "
+        f"(fitted: {', '.join(prof.fitted) or 'none'})"
+    )
+    disagreements = 0
+    for pt in points:
+        d = int(pt.get("d", 0))
+        ratio = float(pt.get("ratio", 0.0))
+        if not d:
+            continue
+        if "n_slices" in pt and "per_slice" in pt:
+            n_slices, per_slice = int(pt["n_slices"]), int(pt["per_slice"])
+            static = costmodel.select_hier_plan(d, n_slices, per_slice, ratio)
+            calib = costmodel.select_hier_plan(
+                d, n_slices, per_slice, ratio, profile=prof
+            )
+            s_key = f"{static['ici']}+{static['dcn']}"
+            c_key = f"{calib['ici']}+{calib['dcn']}"
+            static_under_fitted = calib["table"][s_key]
+            label = f"d={d} ratio={ratio:g} {n_slices}x{per_slice}"
+        elif "workers" in pt:
+            W = int(pt["workers"])
+            s_mode = costmodel.select_rs_mode(d, W, ratio)
+            c_mode = costmodel.select_rs_mode(d, W, ratio, profile=prof)
+            s_key, c_key = s_mode, c_mode
+            static_under_fitted = costmodel.rs_step_time(
+                s_mode, d, W, ratio, profile=prof
+            )
+            calib = {
+                "modeled_step_s": costmodel.rs_step_time(
+                    c_mode, d, W, ratio, profile=prof
+                )
+            }
+            label = f"d={d} ratio={ratio:g} W={W}"
+        else:
+            continue
+        if s_key == c_key:
+            print(
+                f"  {label}: static and calibrated agree on {s_key} "
+                f"({calib['modeled_step_s']:.6g}s under fitted model)"
+            )
+        else:
+            disagreements += 1
+            print(
+                f"  {label}: DISAGREE — static picks {s_key} "
+                f"({static_under_fitted:.6g}s under fitted model), "
+                f"calibrated picks {c_key} "
+                f"({calib['modeled_step_s']:.6g}s, "
+                f"{static_under_fitted / calib['modeled_step_s']:.2f}x better)"
+            )
+    print(f"  {disagreements} pick disagreement(s) across {len(points)} point(s)")
+    return 0
+
+
 def cmd_compare(args) -> int:
+    if args.profile:
+        if not args.against:
+            return _fail("--profile needs --against BENCH.json to re-price")
+        return _compare_profile(args)
+    if not args.run_a:
+        return _fail("compare needs RUN_A (or --profile --against)")
     run_a = _resolve_run(args.run_a)
     if run_a is None:
         return _fail(f"no run directory under {args.run_a!r}")
-    rep_a = _run_report(run_a)
+    rep_a = _run_report(run_a, args.include_warmup)
     t_a = rep_a["step_time_s"].get("mean")
 
     if args.against:
@@ -414,7 +542,7 @@ def cmd_compare(args) -> int:
     run_b = _resolve_run(args.run_b)
     if run_b is None:
         return _fail(f"no run directory under {args.run_b!r}")
-    rep_b = _run_report(run_b)
+    rep_b = _run_report(run_b, args.include_warmup)
     t_b = rep_b["step_time_s"].get("mean")
 
     if args.ctrl:
@@ -433,6 +561,64 @@ def cmd_compare(args) -> int:
         if t_b > t_a * (1.0 + args.tol):
             print(f"  REGRESSION: B exceeds A by more than {args.tol:.0%}")
             return 1
+    return 0
+
+
+# ---------------------------------------------------------------------- #
+# calibrate
+# ---------------------------------------------------------------------- #
+
+
+def cmd_calibrate(args) -> int:
+    run = _resolve_run(args.run)
+    if run is None:
+        return _fail(f"no run directory under {args.run!r}")
+    try:
+        prof = costmodel.calibrate(run, include_warmup=args.include_warmup)
+    except (ValueError, OSError) as e:
+        return _fail(str(e))
+    rec = prof.to_record()
+    costmodel.validate_profile(rec)  # never emit an invalid profile
+    src = prof.source
+    T = float(src["measured_step_s"])
+    P = float(src["predicted_step_s"])
+    err = abs(P - T) / T if T > 0 else float("inf")
+    if args.json:
+        print(json.dumps(rec, indent=2))
+    else:
+        print(f"calibrate: run {run.name}  (W={src['workers']})")
+        print(
+            f"  steps: {src['steps_measured']} measured of "
+            f"{src['steps_total']} ({src['warmup_dropped']} warmup dropped; "
+            f"{src['step_time_source']})"
+        )
+        print(
+            f"  measured step {T:.6g}s  predicted {P:.6g}s  "
+            f"(error {err:.2%}, tol {args.tol:.0%})"
+        )
+        print(
+            f"  components: encode {src['encode_s']:.6g}s  decode "
+            f"{src['decode_s']:.6g}s  wire_dcn {src['wire_dcn_s']:.6g}s  "
+            f"wire_ici {src['wire_ici_s']:.6g}s  compute "
+            f"{src['compute_s']:.6g}s  other {src['other_s']:.6g}s"
+        )
+        print(
+            f"  fitted: bw_dcn {prof.bw_dcn:.6g} B/s  bw_ici "
+            f"{prof.bw_ici:.6g} B/s  t_enc {prof.t_enc_s:.6g}s  t_dec "
+            f"{prof.t_dec_s:.6g}s  compute {prof.compute_time_s:.6g}s"
+        )
+        print(f"    measured: {', '.join(prof.fitted) or '(none)'}")
+        print(f"    held at static constants: {', '.join(prof.fixed) or '(none)'}")
+    if args.out:
+        prof.save(args.out)
+        print(f"wrote profile -> {args.out}")
+    if err > args.tol:
+        print(
+            f"calibrate: REGRESSION: predicted step time misses measured by "
+            f"{err:.2%} (> {args.tol:.0%}) — the fit does not explain this run",
+            file=sys.stderr,
+        )
+        return 1
     return 0
 
 
@@ -636,10 +822,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("summary", help="digest one run")
     p.add_argument("run", help="run dir or tracking root (latest run)")
     p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument("--include-warmup", action="store_true",
+                   help="keep compile-dominated warmup step times in the "
+                        "statistics (dropped by default)")
     p.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("compare", help="diff two runs, or a run vs a bench record")
-    p.add_argument("run_a")
+    p.add_argument("run_a", nargs="?", default="")
     p.add_argument("run_b", nargs="?", default="")
     p.add_argument("--against", default="", metavar="BENCH.json",
                    help="committed bench record (e.g. BENCH_DECODE_r06.json); "
@@ -651,7 +840,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "RUN_B the fixed baseline; compares cumulative wire "
                         "volume at matched (running-min) loss and exits 1 "
                         "when adaptive spent >= wire")
+    p.add_argument("--profile", default="", metavar="PROFILE.json",
+                   help="fitted machine profile (telemetry calibrate --out); "
+                        "with --against, re-prices the bench claim under the "
+                        "profile and reports static-vs-calibrated pick "
+                        "disagreements (no runs needed)")
+    p.add_argument("--include-warmup", action="store_true",
+                   help="keep compile-dominated warmup step times in the "
+                        "statistics (dropped by default)")
     p.set_defaults(fn=cmd_compare)
+
+    p = sub.add_parser(
+        "calibrate",
+        help="fit a machine profile (bw/t_enc/t_dec/compute) from a run's "
+             "telemetry",
+    )
+    p.add_argument("run", help="tracking run dir with --telemetry artifacts")
+    p.add_argument("--out", default="", metavar="PROFILE.json",
+                   help="write the fitted profile record here")
+    p.add_argument("--json", action="store_true",
+                   help="print the full profile record instead of the digest")
+    p.add_argument("--include-warmup", action="store_true",
+                   help="keep compile-dominated warmup steps in the fit "
+                        "(skews the step-time target; default drops them)")
+    p.add_argument("--tol", type=float, default=0.05,
+                   help="max |predicted - measured| / measured step time "
+                        "before exiting 1 (default 5%%)")
+    p.set_defaults(fn=cmd_calibrate)
 
     p = sub.add_parser("trace", help="merged Chrome trace JSON (Perfetto)")
     p.add_argument("run")

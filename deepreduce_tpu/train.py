@@ -319,6 +319,17 @@ class Trainer:
         self._raw_step_cache = {}
         self._exchanger_cache = {}
         self._params_like = None
+        # --- profile-driven re-selection (apply_profile) ---------------- #
+        # Mirrors the controller's bounded-retrace contract with plan
+        # tuples as keys: one exchanger + one jitted step per distinct
+        # auto-selected plan, so applying a fitted machine profile costs at
+        # most one extra compile — and zero when the profile agrees with
+        # the static constants (cache size == plans visited, pinned by the
+        # jx-calib-reselect audit and tests/test_calibrate.py).
+        self._plan_key = None
+        self._plan_step_cache = {}
+        self._plan_raw_cache = {}
+        self._plan_ex_cache = {}
         # host-side mirror of state.step: synced from the device ONCE at
         # the first step() (resume-safe), then incremented locally — so the
         # telemetry-boundary check never adds a per-step host sync
@@ -370,6 +381,10 @@ class Trainer:
             residuals = jax.tree_util.tree_map(
                 lambda r: jnp.broadcast_to(r[None], (self.num_workers,) + r.shape), residuals
             )
+        if self._ctrl is None:
+            self._plan_key = self._plan_key_of(self.exchanger)
+            if self._plan_key is not None:
+                self._plan_ex_cache[self._plan_key] = self.exchanger
         state = TrainState(
             params=params,
             batch_stats=batch_stats,
@@ -517,6 +532,9 @@ class Trainer:
             if self._ctrl is not None:
                 self._step_cache[self._ctrl.index] = self._step_fn
                 self._raw_step_cache[self._ctrl.index] = self._raw_step_fn
+            elif self._plan_key is not None:
+                self._plan_step_cache[self._plan_key] = self._step_fn
+                self._plan_raw_cache[self._plan_key] = self._raw_step_fn
         state_nores = dataclasses.replace(state, residuals=None)
         if self.cfg.telemetry:
             if self._telemetry_acc is None:
@@ -571,6 +589,106 @@ class Trainer:
         out.update({f"window_{k}": v for k, v in acc.derive(window_src).items()})
         self._prev_summary_fetch = vals
         return out
+
+    # ------------------------------------------------------------------ #
+    # fitted-profile re-selection surface (costmodel.MachineProfile)
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _plan_key_of(exchanger) -> Optional[Tuple]:
+        """The auto-selected plan identity of an exchanger, or None when
+        every selector was explicit (nothing a profile could re-select)."""
+        plan = getattr(exchanger, "plan", None)
+        if plan is not None:
+            return ("hier", plan["ici"], plan["dcn"])
+        if exchanger.cfg.rs_mode == "auto":
+            # hier without auto legs delegates the rs resolution to its
+            # inner cross-slice GradientExchanger
+            inner = getattr(exchanger, "exchanger", exchanger)
+            return ("rs", inner._rs_mode)
+        return None
+
+    @property
+    def visited_plan_keys(self) -> Tuple[Tuple, ...]:
+        """Auto-selected plans a step program was actually compiled for —
+        the bounded-retrace witness for profile-driven re-selection
+        (== distinct compiled step executables on this path)."""
+        return tuple(sorted(self._plan_step_cache))
+
+    def apply_profile(self, profile) -> dict:
+        """Re-run this config's 'auto' plan selection under a fitted
+        machine profile (a costmodel.MachineProfile or a path to one) and,
+        when the calibrated argmin differs from the current plan, swap in
+        the re-selected exchanger and its (cached or lazily rebuilt)
+        step program. Contract: a profile that agrees with the static
+        constants is a no-op (same plan key, same program — pinned by the
+        jx-calib-reselect audit), and the compiled-executable count stays
+        == len(visited_plan_keys). Returns the decision record."""
+        from deepreduce_tpu import costmodel
+
+        if self._ctrl is not None:
+            raise ValueError(
+                "apply_profile with ctrl=True would fight the adaptive "
+                "controller for the operating point — use one or the other"
+            )
+        if self.exchanger is None or self._params_like is None:
+            raise ValueError("apply_profile requires init_state() first")
+        if isinstance(profile, (str, bytes)) or hasattr(profile, "__fspath__"):
+            profile = costmodel.load_profile(profile)
+        old_key = self._plan_key_of(self.exchanger)
+        if old_key is None:
+            return {
+                "switched": False,
+                "old": None,
+                "new": None,
+                "reason": "no 'auto' selector in the config — nothing to "
+                          "re-select",
+            }
+        new_key = None
+        for key, ex in self._plan_ex_cache.items():
+            if getattr(ex, "profile", None) is profile:
+                new_key, new_ex = key, ex
+                break
+        if new_key is None:
+            if self.cfg.hier:
+                from deepreduce_tpu.parallel.hierarchical import (
+                    HierarchicalExchanger,
+                )
+
+                new_ex = HierarchicalExchanger(
+                    self._params_like, self.cfg,
+                    num_slices=self.mesh.shape["dcn"],
+                    per_slice=self.mesh.shape["ici"],
+                    profile=profile,
+                )
+            else:
+                new_ex = GradientExchanger(
+                    self._params_like, self.cfg, axis_name=self.axis_name,
+                    num_workers=self.num_workers, profile=profile,
+                )
+            new_key = self._plan_key_of(new_ex)
+        record = {
+            "switched": new_key != old_key,
+            "old": old_key,
+            "new": new_key,
+            "fitted": tuple(profile.fitted),
+        }
+        if getattr(new_ex, "plan", None) is not None:
+            plan = new_ex.plan
+            record["modeled_new_s"] = plan["modeled_step_s"]
+            record["modeled_old_s"] = plan["table"][f"{old_key[1]}+{old_key[2]}"]
+        if new_key == old_key:
+            # same plan: keep the committed exchanger and compiled program —
+            # the candidate differs only in the profile it consulted
+            return record
+        self.exchanger = new_ex
+        self._plan_ex_cache[new_key] = new_ex
+        self._plan_key = new_key
+        # swap in the cached program for the re-selected plan; a miss means
+        # the next step() lazily builds (and caches) exactly one more
+        self._step_fn = self._plan_step_cache.get(new_key)
+        self._raw_step_fn = self._plan_raw_cache.get(new_key)
+        return record
 
     # ------------------------------------------------------------------ #
     # adaptive controller surface (cfg.ctrl)

@@ -1,13 +1,24 @@
-.PHONY: analyze analyze-quick test test-quick telemetry-check chaos-check fedsim-check ctrl-check overlap-check calibrate-check
+.PHONY: analyze analyze-quick matrix-check test test-quick telemetry-check chaos-check fedsim-check ctrl-check overlap-check calibrate-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
 # violation. CPU-only, trace-only (no compiles). Also exercises the
 # telemetry round trip (telemetry-check), the resilience smoke
-# (chaos-check) and the federated round smoke (fedsim-check) so none of
-# those paths can rot while the gate stays green.
-analyze: telemetry-check chaos-check fedsim-check ctrl-check overlap-check calibrate-check
+# (chaos-check), the federated round smoke (fedsim-check) and the
+# composition-lattice legality matrix (matrix-check) so none of those
+# paths can rot while the gate stays green.
+analyze: matrix-check telemetry-check chaos-check fedsim-check ctrl-check overlap-check calibrate-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
+
+# composition-lattice legality gate: probe the full feature cross-product
+# (communicator x decode x buckets x stream x rs_mode x hier x resilience
+# x ctrl x fed), trace every legal cell through the full rule set, and
+# diff legality / reason codes / trace hashes against the committed
+# MATRIX.json — exits nonzero on any violation or drift. Trace-only
+# (abstract meshes, no compiles). Re-baseline deliberately with
+# `python -m deepreduce_tpu.analysis matrix --update`.
+matrix-check:
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis matrix
 
 # adaptive-controller smoke: a short adaptive train on the 8-worker CPU
 # mesh asserts decisions.jsonl is non-empty and schema-valid, the

@@ -1,8 +1,21 @@
-"""CLI gate: `python -m deepreduce_tpu.analysis [--quick] [--out PATH]`.
+"""CLI gate: `python -m deepreduce_tpu.analysis [COMMAND] [flags]`.
 
-Runs the AST lint over the repo and the jaxpr audit over every registered
-codec/communicator config (or the tier-1 quick subset), writes a
-deterministic ANALYSIS.json report, and exits 1 if anything violated.
+Commands:
+
+- ``audit`` (default): AST lint over the repo + the jaxpr audit over every
+  registered codec/communicator config (or the tier-1 ``--quick`` subset).
+  Writes a deterministic ANALYSIS.json; exits 1 on any violation.
+- ``matrix``: probe the full composition lattice (analysis/lattice.py),
+  rebuild MATRIX.json, and diff it against the committed baseline. Exits 1
+  on any rule violation, any codeless rejection, or any legality /
+  reason-code / trace-hash drift vs the baseline; ``--update`` rewrites
+  the baseline instead of failing on drift.
+- ``list``: print every rule id with its one-line contract and exit.
+
+``--only RULE[,RULE]`` restricts the failure gate (and the printed
+violations) to the named rules — the full audit still runs and the report
+still records everything, so a focused run can never silently shrink the
+committed artifact.
 """
 
 from __future__ import annotations
@@ -13,36 +26,50 @@ import sys
 from pathlib import Path
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="python -m deepreduce_tpu.analysis",
-        description="jaxpr invariant audit + repo AST lint",
-    )
-    parser.add_argument(
-        "--quick",
-        action="store_true",
-        help="audit only the tier-1 subset (flagship codec/query + the "
-        "three fused decode strategies)",
-    )
-    parser.add_argument(
-        "--out",
-        type=Path,
-        default=None,
-        help="report path (default: ANALYSIS.json at the repo root; '-' "
-        "to skip writing)",
-    )
-    args = parser.parse_args(argv)
+def _parse_only(spec, parser):
+    from deepreduce_tpu.analysis.rules import ALL_RULE_IDS
 
+    if spec is None:
+        return None
+    rules = [r.strip() for r in spec.split(",") if r.strip()]
+    unknown = [r for r in rules if r not in ALL_RULE_IDS]
+    if unknown:
+        parser.error(
+            f"unknown rule(s) {', '.join(unknown)}; "
+            f"run `list` for the rule table"
+        )
+    return set(rules)
+
+
+def _gate(violations, only):
+    """The subset of violations that fail the run under --only."""
+    if only is None:
+        return list(violations)
+    return [v for v in violations if v.get("rule") in only]
+
+
+def _cmd_list() -> int:
+    from deepreduce_tpu.analysis.rules import ALL_RULE_IDS, RULE_DESCRIPTIONS
+
+    width = max(len(r) for r in ALL_RULE_IDS)
+    for rule in ALL_RULE_IDS:
+        print(f"{rule:<{width}}  {RULE_DESCRIPTIONS[rule]}")
+    return 0
+
+
+def _cmd_audit(args, only) -> int:
     from deepreduce_tpu.analysis.ast_lint import lint_repo
     from deepreduce_tpu.analysis.jaxpr_audit import audit_all
+    from deepreduce_tpu.analysis.lattice import SCHEMA
 
     root = Path(__file__).resolve().parents[2]
     ast_violations = lint_repo(root)
     records, jaxpr_violations = audit_all(quick=args.quick)
 
-    violations = ast_violations + jaxpr_violations
+    violations = [v.to_dict() for v in ast_violations + jaxpr_violations]
     skipped = [r.label for r in records if r.skipped is not None]
     report = {
+        "schema": SCHEMA,
         "quick": args.quick,
         "ast_lint": {
             "violations": [v.to_dict() for v in ast_violations],
@@ -63,14 +90,111 @@ def main(argv=None) -> int:
         out_path.write_text(json.dumps(report, indent=2, sort_keys=True) + "\n")
         print(f"wrote {out_path}")
 
+    gate = _gate(violations, only)
     print(
         f"analysis: {len(records)} traces audited"
         + (f" ({len(skipped)} skipped: {', '.join(skipped)})" if skipped else "")
         + f", {len(ast_violations)} lint + {len(jaxpr_violations)} jaxpr violations"
+        + (f" ({len(gate)} gated by --only)" if only is not None else "")
     )
-    for v in violations:
-        print(f"  [{v.rule}] {v.where}: {v.detail}", file=sys.stderr)
-    return 1 if violations else 0
+    for v in gate:
+        print(f"  [{v['rule']}] {v['where']}: {v['detail']}", file=sys.stderr)
+    return 1 if gate else 0
+
+
+def _cmd_matrix(args, only) -> int:
+    from deepreduce_tpu.analysis import lattice
+
+    root = Path(__file__).resolve().parents[2]
+    baseline_path = args.out if args.out is not None else root / "MATRIX.json"
+
+    report = lattice.build_matrix(
+        progress=lambda m: print(f"  {m}", flush=True)
+    )
+    s = report["summary"]
+    print(
+        f"matrix: {s['cells']} cells -> {s['legal']} legal / "
+        f"{s['rejected']} rejected ({len(s['reason_codes'])} reason codes, "
+        f"{s['distinct_traces']} distinct traces)"
+    )
+
+    gate = _gate(report["violations"], only)
+    for v in gate:
+        print(f"  [{v['rule']}] {v['where']}: {v['detail']}", file=sys.stderr)
+
+    drift = []
+    if str(baseline_path) != "-":
+        if not baseline_path.exists():
+            lattice.write_matrix(report, baseline_path)
+            print(f"wrote {baseline_path} (no baseline existed)")
+        elif args.update:
+            lattice.write_matrix(report, baseline_path)
+            print(f"wrote {baseline_path} (--update)")
+        else:
+            baseline = lattice.load_report(baseline_path)
+            drift = lattice.compare_matrix(baseline, report)
+            for d in drift:
+                print(f"  [matrix-drift] {d}", file=sys.stderr)
+            if not drift:
+                print(f"baseline {baseline_path}: no drift")
+
+    return 1 if (gate or drift) else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m deepreduce_tpu.analysis",
+        description="jaxpr invariant audit + repo AST lint + legality matrix",
+    )
+    parser.add_argument(
+        "command",
+        nargs="?",
+        default="audit",
+        choices=("audit", "matrix", "list"),
+        help="audit (default): fixed trace list -> ANALYSIS.json; "
+        "matrix: full composition lattice -> MATRIX.json; "
+        "list: print the rule table",
+    )
+    parser.add_argument(
+        "--quick",
+        action="store_true",
+        help="audit only the tier-1 subset (flagship codec/query + the "
+        "three fused decode strategies)",
+    )
+    parser.add_argument(
+        "--only",
+        metavar="RULE[,RULE]",
+        default=None,
+        help="gate the exit code on these rule ids only (audit still runs "
+        "and records everything)",
+    )
+    parser.add_argument(
+        "--list",
+        action="store_true",
+        dest="list_rules",
+        help="alias for the `list` command",
+    )
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="matrix: rewrite the committed baseline instead of failing "
+        "on drift",
+    )
+    parser.add_argument(
+        "--out",
+        type=Path,
+        default=None,
+        help="report path (default: ANALYSIS.json / MATRIX.json at the "
+        "repo root; '-' to skip writing)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules or args.command == "list":
+        return _cmd_list()
+    only = _parse_only(args.only, parser)
+    if args.command == "matrix":
+        return _cmd_matrix(args, only)
+    return _cmd_audit(args, only)
 
 
 if __name__ == "__main__":

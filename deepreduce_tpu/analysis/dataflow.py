@@ -1,0 +1,469 @@
+"""SPMD dataflow rules over a flattened jaxpr graph.
+
+The linear-walk rules in rules.py check *presence* properties (which eqns
+exist, what they move). The composition lattice also needs *ordering and
+lineage* properties — does the streaming token chain actually dominate each
+bucket's collective, does anything read a donated buffer after its alias is
+live, does every stochastic draw fold its key — and those are questions
+about the dataflow DAG, not the eqn list.
+
+``build_graph`` flattens a ClosedJaxpr into one linear node list: any call
+eqn whose params carry exactly one sub-jaxpr with matching invar/outvar
+arity (pjit / shard_map / remat / custom_* call bodies) is inlined so data
+dependencies thread straight through it; ``cond`` / ``while`` / ``scan``
+and anything else stay opaque single nodes whose outputs depend on all
+inputs. Node emission order is topological (jaxprs are), so ancestor
+reachability is a single forward pass over Python-int bitsets — cheap even
+for the multi-thousand-eqn fedsim round.
+
+SparCML (arXiv:1802.08021) is the motivation for jx-collective-schedule:
+composed sparse-collective legs are only sound when every worker provably
+enters the same collective sequence, which a collective under data-dependent
+control flow breaks (divergence = deadlock on a real mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepreduce_tpu.analysis.rules import (
+    COLLECTIVE_PRIMS,
+    R_COLLECTIVE_SCHEDULE,
+    R_DONATION,
+    R_KEY_LINEAGE,
+    R_TOKEN_DOMINANCE,
+    AuditContext,
+    Violation,
+    _subjaxprs,
+)
+
+# eqn params control flow recursion must treat as opaque: their sub-jaxprs
+# run data-dependently (branch select / trip count), so inlining them into
+# a straight-line dataflow would fabricate orderings that never execute
+_OPAQUE_PRIMS = ("cond", "while", "scan")
+
+# a ref is a producer handle for one value: ("lit", <repr>) for literals,
+# (node_idx, out_pos) for everything else
+Ref = Tuple[Any, Any]
+
+
+@dataclasses.dataclass
+class FlatEqn:
+    """One node of the flattened graph: a primitive eqn, an opaque call, or
+    a pseudo-source for a top-level invar/constvar."""
+
+    idx: int
+    prim: str
+    eqn: Any  # None for sources
+    in_refs: Tuple[Ref, ...]
+
+
+@dataclasses.dataclass
+class Donation:
+    """One inlined pjit call that donated buffers: which input refs were
+    donated (with their avals) and the resolved refs/avals of the call's
+    outputs, for first-fit alias matching."""
+
+    donated: List[Tuple[int, Ref, Any]]  # (invar pos, ref, aval)
+    out_refs: List[Tuple[Ref, Any]]  # (ref, aval) per call outvar
+
+
+@dataclasses.dataclass
+class DataflowGraph:
+    nodes: List[FlatEqn]
+    donations: List[Donation]
+    # per-node ancestor bitset over node idxs (sources included)
+    anc: List[int]
+
+    def by_prim(self, name: str) -> List[FlatEqn]:
+        return [fe for fe in self.nodes if fe.prim == name]
+
+    def is_ancestor(self, a: int, b: int) -> bool:
+        return bool((self.anc[b] >> a) & 1)
+
+
+def _is_literal(v: Any) -> bool:
+    return hasattr(v, "val")
+
+
+def _lit_ref(v: Any) -> Ref:
+    try:
+        return ("lit", repr(v.val))
+    except Exception:
+        return ("lit", "?")
+
+
+def _inline_target(eqn: Any) -> Optional[Any]:
+    """The single sub-jaxpr an eqn can be inlined through, or None. Opaque
+    control flow never inlines; neither does anything carrying several
+    jaxprs (cond branches) or a jaxpr whose arity disagrees with the eqn
+    (scan's carry/xs split)."""
+    if eqn.primitive.name in _OPAQUE_PRIMS:
+        return None
+    subs = [s for v in eqn.params.values() for s in _subjaxprs(v)]
+    if len(subs) != 1:
+        return None
+    sub = subs[0]
+    inner = getattr(sub, "jaxpr", None)  # ClosedJaxpr exposes .eqns too
+    if inner is not None and hasattr(inner, "eqns"):
+        sub = inner
+    if len(sub.invars) != len(eqn.invars) or len(sub.outvars) != len(eqn.outvars):
+        return None
+    return sub
+
+
+def build_graph(closed_jaxpr: Any) -> DataflowGraph:
+    """Flatten a (Closed)Jaxpr into a DataflowGraph. Eager, order-preserving
+    ref resolution makes it safe to inline the SAME sub-jaxpr object at two
+    call sites (jit caches share jaxprs): each inline re-binds the sub's
+    vars and emits its own node copies before any later binding clobbers
+    the env."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    nodes: List[FlatEqn] = []
+    donations: List[Donation] = []
+    env: Dict[Any, Ref] = {}
+
+    def new_node(prim: str, eqn: Any, in_refs: Tuple[Ref, ...]) -> FlatEqn:
+        fe = FlatEqn(len(nodes), prim, eqn, in_refs)
+        nodes.append(fe)
+        return fe
+
+    def source(var: Any, kind: str) -> None:
+        env[var] = (new_node(f"source:{kind}", None, ()).idx, 0)
+
+    for v in jaxpr.constvars:
+        source(v, "const")
+    for v in jaxpr.invars:
+        source(v, "invar")
+
+    def ref_of(v: Any) -> Ref:
+        if _is_literal(v):
+            return _lit_ref(v)
+        r = env.get(v)
+        if r is None:  # defensively bind stray free vars as sources
+            source(v, "free")
+            r = env[v]
+        return r
+
+    def emit(j: Any) -> None:
+        for eqn in j.eqns:
+            in_refs = tuple(ref_of(v) for v in eqn.invars)
+            sub = _inline_target(eqn)
+            if sub is None:
+                fe = new_node(eqn.primitive.name, eqn, in_refs)
+                for pos, ov in enumerate(eqn.outvars):
+                    env[ov] = (fe.idx, pos)
+                continue
+            for sv, r in zip(sub.invars, in_refs):
+                env[sv] = r
+            for cv in sub.constvars:
+                source(cv, "const")
+            emit(sub)
+            out_refs = [ref_of(ov) for ov in sub.outvars]
+            for ov, r in zip(eqn.outvars, out_refs):
+                env[ov] = r
+            don = eqn.params.get("donated_invars")
+            if don is not None and any(don):
+                donations.append(
+                    Donation(
+                        donated=[
+                            (i, in_refs[i], eqn.invars[i].aval)
+                            for i, d in enumerate(don)
+                            if d and not _is_literal(eqn.invars[i])
+                        ],
+                        out_refs=[
+                            (r, ov.aval) for r, ov in zip(out_refs, eqn.outvars)
+                        ],
+                    )
+                )
+
+    emit(jaxpr)
+
+    anc = [0] * len(nodes)
+    for fe in nodes:
+        a = 0
+        for r in fe.in_refs:
+            if r[0] != "lit":
+                i = r[0]
+                a |= anc[i] | (1 << i)
+        anc[fe.idx] = a
+    return DataflowGraph(nodes=nodes, donations=donations, anc=anc)
+
+
+# ---------------------------------------------------------------------- #
+# jx-collective-schedule
+# ---------------------------------------------------------------------- #
+
+
+def rule_collective_schedule(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """No collective may sit inside a ``cond``/``while`` sub-jaxpr: under
+    SPMD, a data-dependent predicate can diverge across workers, leaving
+    some waiting in a collective the rest never enter — deadlock. ``scan``
+    bodies are fine (static trip count, every worker runs every iteration;
+    the ring decode's ppermute-in-fori_loop lowers there). Always armed."""
+    bad: List[str] = []
+
+    def walk(j: Any, under: Optional[str]) -> None:
+        j = getattr(j, "jaxpr", j)
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if under is not None and name in COLLECTIVE_PRIMS:
+                bad.append(f"{name} under {under}")
+            nested = under if under is not None else (
+                name if name in ("cond", "while") else None
+            )
+            for v in eqn.params.values():
+                for sub in _subjaxprs(v):
+                    walk(sub, nested)
+
+    walk(jaxpr, None)
+    if not bad:
+        return []
+    return [
+        Violation(
+            R_COLLECTIVE_SCHEDULE,
+            ctx.label,
+            f"{len(bad)} collective(s) nested under data-dependent control "
+            f"flow (first: {bad[0]}) — SPMD workers could diverge on whether "
+            "they enter the collective, deadlocking the mesh",
+        )
+    ]
+
+
+# ---------------------------------------------------------------------- #
+# jx-token-dominance
+# ---------------------------------------------------------------------- #
+
+
+def rule_token_dominance(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """The streaming exchange brackets every bucket's dispatch between an
+    entry and an exit ``optimization_barrier`` threaded on one token chain
+    (comm_stream.py). On the trace that means: exactly 2*B barriers, the
+    barriers form a dependency chain in emission order, and every
+    all_gather both descends from a barrier and feeds one — the structural
+    form of 'encode -> all_gather -> decode is ordered per bucket and
+    buckets are ordered against each other'."""
+    if ctx.expect_stream_buckets is None:
+        return []
+    g = build_graph(jaxpr)
+    barriers = g.by_prim("optimization_barrier")
+    gathers = g.by_prim("all_gather")
+    probs: List[str] = []
+    want = 2 * ctx.expect_stream_buckets
+    if len(barriers) != want:
+        probs.append(
+            f"{len(barriers)} optimization_barrier eqn(s); the token chain "
+            f"contracts {want} (2 per bucket x {ctx.expect_stream_buckets})"
+        )
+    barrier_mask = 0
+    for b in barriers:
+        barrier_mask |= 1 << b.idx
+    for fe in gathers:
+        if not (g.anc[fe.idx] & barrier_mask):
+            probs.append(f"all_gather@{fe.idx} has no barrier ancestor")
+        if not any(g.is_ancestor(fe.idx, b.idx) for b in barriers):
+            probs.append(f"all_gather@{fe.idx} feeds no barrier")
+    for a, b in zip(barriers, barriers[1:]):
+        if not g.is_ancestor(a.idx, b.idx):
+            probs.append(
+                f"barrier@{a.idx} is not an ancestor of barrier@{b.idx} — "
+                "token chain broken"
+            )
+    if not probs:
+        return []
+    return [
+        Violation(
+            R_TOKEN_DOMINANCE,
+            ctx.label,
+            f"{len(probs)} token-chain defect(s) (first: {probs[0]})",
+        )
+    ]
+
+
+# ---------------------------------------------------------------------- #
+# jx-donation-soundness
+# ---------------------------------------------------------------------- #
+
+
+def _aval_eq(a: Any, b: Any) -> bool:
+    return (
+        tuple(getattr(a, "shape", ())) == tuple(getattr(b, "shape", ()))
+        and str(getattr(a, "dtype", "?")) == str(getattr(b, "dtype", "?"))
+    )
+
+
+def rule_donation_soundness(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """XLA reuses a donated input's buffer for an output of the same
+    shape/dtype; any eqn still reading the donated value after that output
+    is defined reads freed (rewritten) memory. The jaxpr does not record
+    which output aliases which donated input, so mirror XLA's assignment
+    greedily: each donated invar claims the first same-aval output not yet
+    claimed, and every direct read of the donated ref at a node later than
+    the alias's defining node is flagged. Armed automatically whenever the
+    trace carries a donating call."""
+    g = build_graph(jaxpr)
+    if not g.donations:
+        return []
+    probs: List[str] = []
+    for don in g.donations:
+        claimed: set = set()
+        for _pos, ref, aval in don.donated:
+            if ref[0] == "lit":
+                continue
+            alias: Optional[Ref] = None
+            for j, (oref, oaval) in enumerate(don.out_refs):
+                if j not in claimed and _aval_eq(aval, oaval):
+                    claimed.add(j)
+                    alias = oref
+                    break
+            if alias is None or alias[0] == "lit":
+                continue  # nothing aliased this buffer — no constraint
+            def_idx = alias[0]
+            for fe in g.nodes:
+                if fe.idx > def_idx and ref in fe.in_refs:
+                    probs.append(
+                        f"node {fe.idx} ({fe.prim}) reads the donated buffer "
+                        f"(source node {ref[0]}) after its alias is defined "
+                        f"at node {def_idx} ({g.nodes[def_idx].prim})"
+                    )
+                    break
+    if not probs:
+        return []
+    return [
+        Violation(
+            R_DONATION,
+            ctx.label,
+            f"{len(probs)} read(s) of a donated buffer after its aliased "
+            f"output is live (first: {probs[0]})",
+        )
+    ]
+
+
+# ---------------------------------------------------------------------- #
+# jx-key-lineage
+# ---------------------------------------------------------------------- #
+
+# ops that forward a key value unchanged — the signature rides through
+_KEY_PASS_THROUGH = (
+    "random_wrap",
+    "random_unwrap",
+    "convert_element_type",
+    "copy",
+    "device_put",
+    "squeeze",
+    "reshape",
+)
+
+# ops that pick an element out of a batch of keys (jax.random.split lowers
+# to split -> [unwrap ->] slice -> squeeze [-> wrap]): the signature rides
+# through but is extended with a pick descriptor, so distinct slices of one
+# split stay distinct draws while two identical slices still count as reuse
+_KEY_PICK = ("slice", "dynamic_slice", "gather")
+
+
+def _is_key_aval(aval: Any) -> bool:
+    return str(getattr(aval, "dtype", "")).startswith("key<")
+
+
+def rule_key_lineage(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """Every stochastic draw (``random_bits``) must consume a key whose
+    lineage passes through at least one ``fold_in``, and no two draws may
+    share the same fold signature — the per-worker/per-tensor/per-step key
+    discipline (sparse.per_tensor_key, comm's worker fold) checked on the
+    trace. A signature is the chain of fold descriptors (literal value, or
+    the producing node of a traced operand like axis_index) accumulated
+    from the key's origin; it deliberately ignores intermediate
+    wrap/unwrap hops, which every jax.random call inserts. Armed per-trace
+    (ctx.require_key_lineage): codec unit audits legitimately pass raw
+    unfolded keys."""
+    if not ctx.require_key_lineage:
+        return []
+    g = build_graph(jaxpr)
+    sigs: Dict[Ref, Tuple[tuple, bool]] = {}
+    draws: Dict[tuple, int] = {}
+    unfolded: List[int] = []
+    reused: List[str] = []
+
+    def sig_of(ref: Ref) -> Tuple[tuple, bool]:
+        got = sigs.get(ref)
+        if got is not None:
+            return got
+        return ((("src", ref),), False)
+
+    for fe in g.nodes:
+        name = fe.prim
+        if name == "random_seed":
+            if fe.in_refs and fe.in_refs[0][0] == "lit":
+                # a literal-seeded key is a trace-constant stream — equal on
+                # every worker and every step, so fold discipline is moot;
+                # keyed by the literal so two PRNGKey(42) streams collide in
+                # reuse detection
+                sigs[(fe.idx, 0)] = ((("seed-const", fe.in_refs[0][1]),), True)
+            else:
+                sigs[(fe.idx, 0)] = ((("seed", fe.idx),), False)
+        elif name == "random_fold_in":
+            parent, _folded = sig_of(fe.in_refs[0])
+            sigs[(fe.idx, 0)] = (parent + (("fold", fe.in_refs[1]),), True)
+        elif name == "random_bits":
+            sig, folded = sig_of(fe.in_refs[0])
+            if not folded:
+                unfolded.append(fe.idx)
+            prev = draws.get(sig)
+            if prev is None:
+                draws[sig] = fe.idx
+            else:
+                reused.append(f"draws @{prev} and @{fe.idx}")
+        elif name in _KEY_PASS_THROUGH:
+            if fe.in_refs:
+                s = sigs.get(fe.in_refs[0])
+                if s is not None and fe.eqn is not None:
+                    for pos in range(len(fe.eqn.outvars)):
+                        sigs[(fe.idx, pos)] = s
+        elif name in _KEY_PICK:
+            if fe.in_refs:
+                s = sigs.get(fe.in_refs[0])
+                if s is not None and fe.eqn is not None:
+                    parent, folded = s
+                    try:
+                        static = repr(sorted(fe.eqn.params.items()))
+                    except Exception:
+                        static = name
+                    pick = ("pick", name, static, tuple(fe.in_refs[1:]))
+                    for pos in range(len(fe.eqn.outvars)):
+                        sigs[(fe.idx, pos)] = (parent + (pick,), folded)
+        elif fe.eqn is not None:
+            # any other producer of a key-typed value (split, ...) derives
+            # fresh distinct keys: give each output a unique signature that
+            # inherits the folded flag
+            folded_in = any(
+                sigs.get(r, ((), False))[1] for r in fe.in_refs if r[0] != "lit"
+            )
+            for pos, ov in enumerate(fe.eqn.outvars):
+                if _is_key_aval(getattr(ov, "aval", None)):
+                    sigs[(fe.idx, pos)] = (
+                        (("op", name, fe.idx, pos),),
+                        folded_in,
+                    )
+    probs: List[str] = []
+    if unfolded:
+        probs.append(
+            f"{len(unfolded)} draw(s) from a key that never passed through "
+            f"fold_in (first random_bits @{unfolded[0]})"
+        )
+    if reused:
+        probs.append(
+            f"{len(reused)} pair(s) of draws share one fold signature "
+            f"(first: {reused[0]})"
+        )
+    if not probs:
+        return []
+    return [Violation(R_KEY_LINEAGE, ctx.label, "; ".join(probs))]
+
+
+DATAFLOW_RULES = (
+    rule_collective_schedule,
+    rule_token_dominance,
+    rule_donation_soundness,
+    rule_key_lineage,
+)

@@ -38,6 +38,7 @@ from deepreduce_tpu.analysis.rules import (
     R_RETRACE,
     Violation,
     collective_counts,
+    collective_counts_by_axis,
     jaxpr_hash,
     run_rules,
 )
@@ -63,6 +64,8 @@ class TraceRecord:
     jaxpr_hash: str
     payload_bytes: Optional[int] = None
     skipped: Optional[str] = None
+    # {mesh axis: {prim: count}} — the fabric-split view of `collectives`
+    collectives_by_axis: Optional[Dict[str, Dict[str, int]]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -75,6 +78,8 @@ class TraceRecord:
             out["payload_bytes"] = self.payload_bytes
         if self.skipped is not None:
             out["skipped"] = self.skipped
+        if self.collectives_by_axis:
+            out["collectives_by_axis"] = self.collectives_by_axis
         return out
 
 
@@ -137,6 +142,7 @@ def trace_and_check(
         collectives=collective_counts(closed),
         jaxpr_hash=h1,
         payload_bytes=payload_bytes,
+        collectives_by_axis=collective_counts_by_axis(closed) or None,
     )
 
 
@@ -366,6 +372,9 @@ def audit_exchange(
         expected_wire_bytes=pb,
         num_workers=NUM_WORKERS,
         expect_codec_invocations=expect_codec,
+        # exchange-level traces contract the per-worker/tensor/step fold
+        # discipline (codec unit audits legitimately pass raw keys)
+        require_key_lineage=True,
     )
     return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
 
@@ -473,6 +482,7 @@ def audit_hier_exchange(
         wire_axis="dcn",
         num_workers=n_slices,
         expect_codec_invocations=expect_codec,
+        require_key_lineage=True,
     )
     return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
 
@@ -573,6 +583,7 @@ def audit_fedsim_round(*, d: int = 512) -> List[TraceRecord]:
         expected_wire_bytes=pb,
         num_workers=NUM_WORKERS,
         expect_codec_invocations=2,
+        require_key_lineage=True,
     )
     return [trace_and_check("fedsim:round", fn, args, ctx, payload_bytes=pb)]
 
@@ -736,6 +747,8 @@ def audit_streaming_exchange() -> List[TraceRecord]:
         expected_wire_bytes=pb,
         num_workers=NUM_WORKERS,
         expect_codec_invocations=_BUCKET_COUNT,
+        expect_stream_buckets=_BUCKET_COUNT,
+        require_key_lineage=True,
     )
     return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
 

@@ -1,0 +1,535 @@
+"""Composition-lattice auditor: machine-checked legality over every
+flagship feature combination.
+
+The fixed audit list (jaxpr_audit.audit_specs) pins ~66 hand-chosen
+programs. This module probes the FULL cross-product of the flagship
+feature axes — communicator x decode_strategy x bucket_bytes x
+stream_exchange x rs_mode x hier(+ici/dcn legs) x resilience x ctrl x
+fed — and partitions it into LEGAL and REJECTED:
+
+- a REJECTED cell records WHERE it was refused (config `__post_init__`
+  vs exchanger construction) and the machine-readable `reason_code` the
+  raising `ConfigError` carries, so the exclusion matrix is data, not
+  prose scattered across error messages;
+- a LEGAL cell's step function is traced to jaxpr on the appropriate
+  AbstractMesh (flat 8-way, hierarchical 2x4, the streaming grad hook,
+  or the federated round) and run through the FULL rule set — the
+  linear-walk rules plus the dataflow rules (collective schedule, token
+  dominance, donation soundness, key lineage) — with the per-axis
+  collective inventory and wire bytes recorded per cell.
+
+The result is a deterministic MATRIX.json. `python -m
+deepreduce_tpu.analysis matrix` regenerates it and exits 1 on any rule
+violation OR any legality/trace drift from the committed baseline: the
+exclusion matrix can only shrink deliberately, and the planned
+composability refactor (ROADMAP) gets a cell-by-cell equivalence oracle.
+
+Cells sharing one effective traced program (the ctrl axis is host-side
+by the audited jx-ctrl-ladder off-identity contract, so ctrl knobs are
+stripped from the trace fingerprint) share one memoized trace — the
+lattice has 15k cells but only tens of distinct programs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deepreduce_tpu.config import ConfigError, DeepReduceConfig, reason_code_of
+
+SCHEMA = "deepreduce_tpu/analysis-report/v1"
+
+# ---------------------------------------------------------------------- #
+# the lattice axes
+# ---------------------------------------------------------------------- #
+
+# (axis name, value labels) in lexicographic cell order. Every label maps
+# to concrete config kwargs in `cell_kwargs`; the cross-product is the
+# probed lattice (4*3*2*2*5*4*2*2*2 = 15360 cells).
+AXES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("communicator", ("allgather", "allreduce", "qar", "sparse_rs")),
+    ("decode", ("loop", "vmap", "ring")),
+    ("buckets", ("off", "on")),
+    ("stream", ("off", "on")),
+    ("rs_mode", ("sparse", "adaptive", "quantized", "sketch", "auto")),
+    ("hier", ("off", "dense", "qar_ici", "auto_dcn")),
+    ("resilience", ("off", "on")),
+    ("ctrl", ("off", "on")),
+    ("fed", ("off", "on")),
+)
+
+# ctrl + telemetry are host-side only (the audited jx-ctrl-ladder
+# off-identity contract; re-verified empirically — identical jaxpr hash
+# with them on/off): these kwargs never reach the traced program, so they
+# are stripped from the trace fingerprint and memoized cells share a trace
+_CTRL_KWARGS = ("ctrl", "ctrl_ladder", "telemetry")
+
+
+def iter_cells():
+    """Yield every cell as {axis: label}, in lexicographic product order —
+    the order `cells` is serialized in."""
+    names = [n for n, _ in AXES]
+    for combo in itertools.product(*(vals for _, vals in AXES)):
+        yield dict(zip(names, combo))
+
+
+def n_cells() -> int:
+    out = 1
+    for _, vals in AXES:
+        out *= len(vals)
+    return out
+
+
+def cell_kwargs(cell: Dict[str, str]) -> Dict[str, Any]:
+    """Concrete DeepReduceConfig kwargs for one cell. Pure and total: every
+    cell maps to kwargs; whether they survive `__post_init__` is exactly
+    what the probe measures."""
+    from deepreduce_tpu.analysis.jaxpr_audit import (
+        _BUCKET_BYTES,
+        _CTRL_LADDER,
+        _FLAGSHIP,
+    )
+
+    comm = cell["communicator"]
+    if comm == "allgather":
+        kw: Dict[str, Any] = dict(memory="residual", **_FLAGSHIP)
+    elif comm == "allreduce":
+        kw = dict(
+            communicator="allreduce", compressor="none", memory="none",
+            deepreduce=None,
+        )
+    elif comm == "qar":
+        kw = dict(
+            communicator="qar", compressor="none", memory="none",
+            deepreduce=None,
+        )
+    else:
+        kw = dict(
+            communicator="sparse_rs", compressor="topk", memory="none",
+            deepreduce=None, compress_ratio=0.02,
+        )
+    kw["decode_strategy"] = cell["decode"]
+    if cell["decode"] == "vmap":
+        kw["decode_batch"] = 4
+    if cell["buckets"] == "on":
+        kw["bucket_bytes"] = _BUCKET_BYTES
+    if cell["stream"] == "on":
+        kw["stream_exchange"] = True
+    if cell["rs_mode"] != "sparse":
+        kw["rs_mode"] = cell["rs_mode"]
+    if cell["hier"] != "off":
+        kw["hier"] = True
+        if cell["hier"] == "qar_ici":
+            kw["hier_ici"] = "qar"
+        elif cell["hier"] == "auto_dcn":
+            kw["hier_dcn"] = "auto"
+    if cell["resilience"] == "on":
+        kw.update(resilience=True, payload_checksum=True, chaos_corrupt_rate=0.2)
+    if cell["ctrl"] == "on":
+        kw.update(ctrl=True, telemetry=True, ctrl_ladder=_CTRL_LADDER)
+    if cell["fed"] == "on":
+        kw.update(
+            fed=True, fed_num_clients=64, fed_clients_per_round=16,
+            fed_local_steps=2,
+        )
+    return kw
+
+
+def trace_fingerprint(kw: Dict[str, Any], harness: str) -> str:
+    """Stable fingerprint of the traced program a cell resolves to: the
+    harness name plus every config kwarg that can reach the trace (ctrl
+    knobs stripped — host-side by contract)."""
+    eff = {k: v for k, v in sorted(kw.items()) if k not in _CTRL_KWARGS}
+    blob = json.dumps({"harness": harness, "kw": eff}, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------- #
+# per-cell probing
+# ---------------------------------------------------------------------- #
+
+
+def _harness_name(cell: Dict[str, str]) -> str:
+    if cell["fed"] == "on":
+        return "fed"
+    if cell["stream"] == "on":
+        return "stream"
+    if cell["hier"] != "off":
+        return "hier"
+    return "flat"
+
+
+def _wire_mode(cfg: DeepReduceConfig) -> Optional[str]:
+    """Which wire-accounting contract a config's trace can be pinned to —
+    mirrors the fixed audits' arming."""
+    if cfg.communicator == "sparse_rs":
+        return "collective"
+    if cfg.communicator == "allgather" and cfg.fused:
+        return "ring" if cfg.decode_strategy == "ring" else "allgather"
+    return None
+
+
+def _trace_flat(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
+    from deepreduce_tpu.analysis import jaxpr_audit as ja
+
+    leaves = ja._BUCKET_LEAVES if cfg.bucket_bytes is not None else None
+    (rec,) = ja.audit_exchange(
+        label, cfg, leaves=leaves, wire_mode=_wire_mode(cfg),
+        with_mask=cell["resilience"] == "on",
+    )
+    return rec
+
+
+def _trace_hier(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
+    from deepreduce_tpu.analysis import jaxpr_audit as ja
+
+    leaves = ja._BUCKET_LEAVES if cfg.bucket_bytes is not None else None
+    (rec,) = ja.audit_hier_exchange(
+        label, cfg, leaves=leaves, wire_mode=_wire_mode(cfg),
+    )
+    return rec
+
+
+def _trace_stream(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
+    """The streaming grad+exchange harness, parametrized over cfg (the
+    fixed audit hardcodes the flagship config): trace
+    StreamingExchange.value_and_grad_exchange over the bucketed census
+    with the token-dominance rule armed at the actual bucket count."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from deepreduce_tpu.analysis import jaxpr_audit as ja
+    from deepreduce_tpu.analysis.rules import AuditContext
+    from deepreduce_tpu.comm import GradientExchanger
+    from deepreduce_tpu.comm_stream import StreamingExchange
+
+    tmap = jax.tree_util.tree_map
+    mesh = ja.audit_mesh()
+    grads_like = {
+        n: ja._sds((int(sz),)) for n, sz in ja._BUCKET_LEAVES.items()
+    }
+    ex = GradientExchanger(
+        grads_like, cfg, axis_name=ja.AXIS, num_workers=ja.NUM_WORKERS
+    )
+    stream = StreamingExchange(ex)
+    n_buckets = len(ex._bucketed.codecs)
+    pb = ex.payload_bytes(grads_like)
+    g_w = tmap(lambda s: ja._sds((ja.NUM_WORKERS,) + s.shape), grads_like)
+
+    def loss_fn(params, batch_stats, batch):
+        loss = sum(jnp.sum(p * batch[n]) for n, p in params.items())
+        return loss, batch_stats
+
+    def spmd(p, b_w, res, step):
+        b0 = tmap(lambda x: x[0], b_w)
+        res0 = tmap(lambda r: r[0], res)
+        _, _, agg, new_res, _ = stream.value_and_grad_exchange(
+            loss_fn, p, {}, b0, res0, step=step
+        )
+        new_res = tmap(lambda r: r[None], new_res)
+        return tmap(lambda x: x[None], agg), new_res
+
+    fn = ja._shard_map(
+        spmd, mesh, (P(), P(ja.AXIS), P(ja.AXIS), P()), (P(ja.AXIS), P(ja.AXIS))
+    )
+    args = (grads_like, g_w, g_w, ja._STEP)
+    ctx = AuditContext(
+        label=label,
+        wire_mode="allgather",
+        expected_wire_bytes=pb,
+        num_workers=ja.NUM_WORKERS,
+        expect_stream_buckets=n_buckets,
+        require_key_lineage=True,
+    )
+    return ja.trace_and_check(label, fn, args, ctx, payload_bytes=pb)
+
+
+def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
+    """The federated round harness, parametrized over cfg (the fixed audit
+    hardcodes the flagship config): one jitted shard_map round over the
+    client-sharded residual bank, wire accounting pinned to the single
+    fused psum's 4*(param_elements + 6) B/worker."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from deepreduce_tpu.analysis import jaxpr_audit as ja
+    from deepreduce_tpu.analysis.rules import AuditContext
+    from deepreduce_tpu.fedsim.sim import FedSim, synthetic_linear_problem
+
+    tmap = jax.tree_util.tree_map
+    fed = cfg.fed_config()
+    params0, data_fn, loss_fn = synthetic_linear_problem(512, 4, fed.local_steps)
+    fs = FedSim(
+        loss_fn, cfg, fed, optax.sgd(0.1), data_fn, mesh=ja.audit_mesh(),
+        axis=ja.AXIS,
+    )
+    params_sds = tmap(lambda p: ja._sds(p.shape, p.dtype), params0)
+    if cfg.payload_checksum or cfg.chaos_corrupt_rate:
+        fs.build_layout(params_sds)
+    fn = fs.sharded_round_fn()
+    bank_sds = tmap(
+        lambda p: ja._sds((fed.num_clients,) + p.shape, p.dtype), params_sds
+    )
+    n_elems = sum(
+        int(jnp.prod(jnp.array(p.shape))) if p.shape else 1
+        for p in jax.tree_util.tree_leaves(params_sds)
+    )
+    pb = 4 * (n_elems + 6)
+    args = (
+        params_sds,
+        params_sds,
+        bank_sds,
+        None,
+        ja._STEP,
+        ja._sds((2,), jnp.uint32),
+    )
+    ctx = AuditContext(
+        label=label,
+        wire_mode="collective",
+        expected_wire_bytes=pb,
+        num_workers=ja.NUM_WORKERS,
+        require_key_lineage=True,
+    )
+    return ja.trace_and_check(label, fn, args, ctx, payload_bytes=pb)
+
+
+_HARNESSES: Dict[str, Callable] = {
+    "flat": _trace_flat,
+    "hier": _trace_hier,
+    "stream": _trace_stream,
+    "fed": _trace_fed,
+}
+
+
+def probe_partition(cell: Dict[str, str]):
+    """Config-stage probe only (no tracing): returns ("legal", cfg, kw) or
+    ("rejected", stage, exc_name, reason_code). Cheap enough to run over
+    the whole lattice in tests."""
+    kw = cell_kwargs(cell)
+    try:
+        cfg = DeepReduceConfig(**kw)
+    except ValueError as e:
+        return ("rejected", "config", type(e).__name__, reason_code_of(e))
+    return ("legal", cfg, kw)
+
+
+def probe_cell(cell: Dict[str, str], memo: Dict[str, Tuple[str, Any]]):
+    """Full probe of one cell: partition, then (for legal cells) build and
+    trace through the cell's harness, memoized on the trace fingerprint.
+
+    Returns a cell entry dict plus (for legal cells) the (label, record)
+    pair. Construction-stage ConfigError/ValueError becomes a 'build'
+    rejection; anything raised during tracing propagates — a trace crash
+    is a harness bug, not a legality fact."""
+    part = probe_partition(cell)
+    if part[0] == "rejected":
+        _, stage, exc, code = part
+        return (
+            {"status": "rejected", "stage": stage, "exception": exc,
+             "reason_code": code},
+            None,
+        )
+    _, cfg, kw = part
+    harness = _harness_name(cell)
+    fp = trace_fingerprint(kw, harness)
+    if fp in memo:
+        label, rec = memo[fp]
+        return ({"status": "legal", "trace": label}, (label, rec))
+    label = f"lat:{fp[:12]}"
+    try:
+        rec = _HARNESSES[harness](label, cfg, cell)
+    except ConfigError as e:
+        return (
+            {"status": "rejected", "stage": "build",
+             "exception": type(e).__name__, "reason_code": e.reason_code},
+            None,
+        )
+    except ValueError as e:
+        # a build-time refusal that never got a reason code: recorded as a
+        # codeless rejection, which build_matrix turns into a violation —
+        # the acceptance bar is that every rejection is machine-readable
+        return (
+            {"status": "rejected", "stage": "build",
+             "exception": type(e).__name__, "reason_code": None},
+            None,
+        )
+    memo[fp] = (label, rec)
+    return ({"status": "legal", "trace": label}, (label, rec))
+
+
+# ---------------------------------------------------------------------- #
+# matrix build / serialize / compare
+# ---------------------------------------------------------------------- #
+
+
+def build_matrix(progress: Optional[Callable[[str], None]] = None) -> Dict[str, Any]:
+    """Probe every cell and assemble the MATRIX report: `entries` is the
+    deduplicated outcome table (first-encounter order), `cells` maps each
+    lattice cell (lexicographic order) to an entry index, `traces` holds
+    one record per distinct traced program."""
+    memo: Dict[str, Tuple[str, Any]] = {}
+    entries: List[Dict[str, Any]] = []
+    entry_index: Dict[str, int] = {}
+    cells: List[int] = []
+    trace_meta: Dict[str, Dict[str, Any]] = {}
+    violations: List[Dict[str, str]] = []
+    codeless: List[str] = []
+    done = 0
+    for cell in iter_cells():
+        entry, traced = probe_cell(cell, memo)
+        key = json.dumps(entry, sort_keys=True)
+        if key not in entry_index:
+            entry_index[key] = len(entries)
+            entries.append(entry)
+        cells.append(entry_index[key])
+        if entry["status"] == "rejected" and entry["reason_code"] is None:
+            codeless.append(_cell_slug(cell))
+        if traced is not None:
+            label, rec = traced
+            if label not in trace_meta:
+                meta = rec.to_dict()
+                meta["config"] = {
+                    k: v for k, v in sorted(cell_kwargs(cell).items())
+                    if k not in _CTRL_KWARGS
+                }
+                meta["harness"] = _harness_name(cell)
+                meta.pop("label", None)
+                meta.pop("violations", None)
+                trace_meta[label] = meta
+                violations.extend(v.to_dict() for v in rec.violations)
+        done += 1
+        if progress is not None and done % 2048 == 0:
+            progress(f"{done}/{n_cells()} cells probed, "
+                     f"{len(trace_meta)} distinct traces")
+    for slug in codeless[:20]:
+        violations.append(
+            {
+                "rule": "matrix-codeless-rejection",
+                "where": slug,
+                "detail": "REJECTED without a machine-readable reason_code — "
+                "convert the raising ValueError to config.ConfigError",
+            }
+        )
+    n_legal = sum(1 for i in cells if entries[i]["status"] == "legal")
+    report = {
+        "schema": SCHEMA,
+        "axes": [[name, list(vals)] for name, vals in AXES],
+        "entries": entries,
+        "cells": cells,
+        "traces": trace_meta,
+        "violations": violations,
+        "summary": {
+            "cells": len(cells),
+            "legal": n_legal,
+            "rejected": len(cells) - n_legal,
+            "distinct_traces": len(trace_meta),
+            "reason_codes": sorted(
+                {
+                    e["reason_code"]
+                    for e in entries
+                    if e["status"] == "rejected" and e["reason_code"]
+                }
+            ),
+            "violations": len(violations),
+        },
+    }
+    return report
+
+
+def _cell_slug(cell: Dict[str, str]) -> str:
+    return "/".join(f"{n}={cell[n]}" for n, _ in AXES)
+
+
+def write_matrix(report: Dict[str, Any], path: Path) -> None:
+    """Deterministic writer: standard indented JSON with the (15k-int)
+    `cells` list packed 64 per line so the committed file stays diffable
+    and an order of magnitude smaller than naive indent."""
+    obj = dict(report)
+    cells = obj["cells"]
+    obj["cells"] = "@CELLS@"
+    txt = json.dumps(obj, indent=2, sort_keys=True)
+    lines = []
+    for i in range(0, len(cells), 64):
+        chunk = ",".join(str(c) for c in cells[i : i + 64])
+        lines.append("    " + chunk)
+    cells_txt = "[\n" + ",\n".join(lines) + "\n  ]"
+    path.write_text(txt.replace('"@CELLS@"', cells_txt) + "\n")
+
+
+def load_report(path: Path, *, expect_schema: str = SCHEMA) -> Dict[str, Any]:
+    """Load + schema-validate a committed report (ANALYSIS.json or
+    MATRIX.json). A missing/mismatched schema tag fails loudly — never
+    diff against a stale or foreign baseline."""
+    try:
+        report = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"cannot load report {path}: {e}") from e
+    got = report.get("schema")
+    if got != expect_schema:
+        raise ValueError(
+            f"{path} carries schema {got!r}, expected {expect_schema!r} — "
+            "stale or malformed baseline; regenerate it (matrix --update / "
+            "make analyze)"
+        )
+    return report
+
+
+def compare_matrix(
+    baseline: Dict[str, Any], fresh: Dict[str, Any], *, limit: int = 25
+) -> List[str]:
+    """Cell-by-cell legality + trace-hash drift between a committed
+    baseline and a fresh build. Any returned diff means the legality
+    surface or a traced program changed without a deliberate re-baseline."""
+    diffs: List[str] = []
+    if baseline.get("axes") != fresh.get("axes"):
+        return ["axes changed — the lattice itself moved; re-baseline deliberately"]
+
+    def resolved(report):
+        entries = report["entries"]
+        traces = report["traces"]
+        for idx in report["cells"]:
+            e = entries[idx]
+            if e["status"] == "legal":
+                yield ("legal", None, traces[e["trace"]]["jaxpr_hash"])
+            else:
+                yield ("rejected", e.get("reason_code"), None)
+
+    if len(baseline["cells"]) != len(fresh["cells"]):
+        return [
+            f"cell count changed: {len(baseline['cells'])} -> "
+            f"{len(fresh['cells'])}"
+        ]
+    for cell, old, new in zip(iter_cells(), resolved(baseline), resolved(fresh)):
+        if old == new:
+            continue
+        if len(diffs) >= limit:
+            diffs.append("... (more diffs suppressed)")
+            break
+        if old[0] != new[0]:
+            diffs.append(
+                f"{_cell_slug(cell)}: legality changed {old[0]} -> {new[0]}"
+            )
+        elif old[0] == "rejected":
+            diffs.append(
+                f"{_cell_slug(cell)}: reason_code changed "
+                f"{old[1]} -> {new[1]}"
+            )
+        else:
+            diffs.append(
+                f"{_cell_slug(cell)}: trace hash changed {old[2]} -> {new[2]}"
+            )
+    return diffs
+
+
+def matrix_reason_codes(report: Dict[str, Any]) -> set:
+    """Every reason_code appearing in a matrix report."""
+    return {
+        e["reason_code"]
+        for e in report["entries"]
+        if e["status"] == "rejected" and e.get("reason_code")
+    }

@@ -58,6 +58,12 @@ R_CTRL_LADDER = "jx-ctrl-ladder"
 # must trace byte-identical to one built with no profile at all
 # (re-selection swaps which cached program runs; it never edits a program)
 R_CALIB_RESELECT = "jx-calib-reselect"
+# dataflow rules (analysis/dataflow.py) — they run on a flattened dataflow
+# graph of the jaxpr rather than a linear eqn walk
+R_COLLECTIVE_SCHEDULE = "jx-collective-schedule"
+R_TOKEN_DOMINANCE = "jx-token-dominance"
+R_DONATION = "jx-donation-soundness"
+R_KEY_LINEAGE = "jx-key-lineage"
 
 ALL_RULE_IDS = (
     R_F64,
@@ -72,7 +78,32 @@ ALL_RULE_IDS = (
     R_RESILIENCE_OFF,
     R_CTRL_LADDER,
     R_CALIB_RESELECT,
+    R_COLLECTIVE_SCHEDULE,
+    R_TOKEN_DOMINANCE,
+    R_DONATION,
+    R_KEY_LINEAGE,
 )
+
+# one-line summaries for ``python -m deepreduce_tpu.analysis --list``; tests
+# assert this dict covers ALL_RULE_IDS exactly
+RULE_DESCRIPTIONS = {
+    R_F64: "no float64/complex128 aval anywhere in the traced hot path",
+    R_DYNAMIC_SHAPE: "every aval dim is a concrete int (no per-step recompiles)",
+    R_UNSORTED_BUDGET_GATHER: "budget-scale gathers/scatters declare sorted/unique indices",
+    R_GATHER_IN_MOD_QUERY: "the mod-blocked bloom universe query is gather-free",
+    R_COLLECTIVE_COUNT: "exact static collective inventory (flat and per mesh axis)",
+    R_WIRE_ACCOUNTING: "collective operand bytes equal payload_bytes() exactly",
+    R_CALLBACK: "host callbacks only inside the whitelisted host codecs",
+    R_CODEC_COUNT: "exact sparsifier-selection count: O(leaves) vs O(buckets) encode",
+    R_RETRACE: "two traces of the same step hash identically (no retrace drift)",
+    R_RESILIENCE_OFF: "resilience=off traces byte-identical to the no-seam program",
+    R_CTRL_LADDER: "controller ladder: one distinct executable per rung, host-side only",
+    R_CALIB_RESELECT: "a constants-restating profile changes no selector pick or trace",
+    R_COLLECTIVE_SCHEDULE: "no collective nested under data-dependent cond/while",
+    R_TOKEN_DOMINANCE: "streaming barrier token chain orders encode -> all_gather -> decode",
+    R_DONATION: "no equation reads a donated input after its aliased output is live",
+    R_KEY_LINEAGE: "every PRNG draw's key folds from the step key; no key reuse",
+}
 
 # sparsifier-selection primitives: every TensorCodec encode lowers its
 # top-k selection to exactly one of these, so their static eqn count is the
@@ -152,6 +183,16 @@ class AuditContext:
     # exact static count of sparsifier-selection eqns (top_k/approx_top_k):
     # O(leaves) on the per-tensor path, O(buckets) on the bucketed path
     expect_codec_invocations: Optional[int] = None
+    # arms jx-token-dominance: the streaming exchange dispatches each bucket
+    # between an entry and an exit optimization_barrier, so the trace must
+    # carry exactly 2*B barriers forming a dependency chain that brackets
+    # every all_gather
+    expect_stream_buckets: Optional[int] = None
+    # arms jx-key-lineage: in this trace every random_bits draw must consume
+    # a key that passed through fold_in (and no two draws share a fold
+    # signature). Only set on traces whose base key is contracted to be
+    # folded per worker/tensor/step — codec-unit audits pass raw keys
+    require_key_lineage: bool = False
 
 
 # ---------------------------------------------------------------------- #
@@ -547,8 +588,12 @@ JAXPR_RULES = (
 
 
 def run_rules(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
-    """Run every jaxpr rule over one traced program."""
+    """Run every jaxpr rule over one traced program — the linear-walk rules
+    above plus the dataflow-graph rules (imported late: dataflow.py imports
+    this module's plumbing)."""
+    from deepreduce_tpu.analysis import dataflow
+
     out: List[Violation] = []
-    for rule in JAXPR_RULES:
+    for rule in JAXPR_RULES + dataflow.DATAFLOW_RULES:
         out.extend(rule(jaxpr, ctx))
     return out

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepreduce_tpu import comm_ring, memory
-from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.config import ConfigError, DeepReduceConfig
 from deepreduce_tpu.resilience.chaos import ChaosInjector
 from deepreduce_tpu.metrics import (
     WireStats,
@@ -253,7 +253,8 @@ class GradientExchanger:
             or cfg.compressor not in ("none",)
             or cfg.memory == "residual"
         ):
-            raise ValueError(
+            raise ConfigError(
+                "build-qar-codec-stack",
                 "communicator='qar' quantizes the DENSE gradient inside the "
                 "collective and never runs the sparsifier, codecs, or "
                 "error-feedback (its quantization is unbiased); "
@@ -265,7 +266,8 @@ class GradientExchanger:
         if cfg.communicator == "sparse_rs" and (
             cfg.deepreduce is not None or cfg.compressor != "topk"
         ):
-            raise ValueError(
+            raise ConfigError(
+                "build-sparse-rs-codec-stack",
                 "communicator='sparse_rs' top-k-sparsifies and routes "
                 "entries itself (sparse_rs.py); a deepreduce codec stack or "
                 "a different sparsifier would be silently ignored — got "
@@ -288,7 +290,8 @@ class GradientExchanger:
         self._rs_mode = cfg.rs_mode
         if cfg.communicator == "sparse_rs" and cfg.rs_mode == "auto":
             if num_workers is None:
-                raise ValueError(
+                raise ConfigError(
+                    "build-rs-auto-needs-workers",
                     "rs_mode='auto' resolves against the W-aware cost model "
                     "at construction and needs the static mesh size: "
                     "construct GradientExchanger(..., num_workers=...)"
@@ -324,7 +327,8 @@ class GradientExchanger:
         self._checksum = bool(cfg.payload_checksum)
         if cfg.bucket_bytes is not None:
             if not (cfg.fused and cfg.communicator == "allgather"):
-                raise ValueError(
+                raise ConfigError(
+                    "build-buckets-need-fused-allgather",
                     "bucket_bytes partitions the FUSED allgather exchange and "
                     "would be silently ignored here "
                     f"(communicator={cfg.communicator!r}, fused={cfg.fused}) — "
@@ -332,7 +336,8 @@ class GradientExchanger:
                     "bucket_bytes=None"
                 )
             if cfg.decode_strategy == "ring":
-                raise ValueError(
+                raise ConfigError(
+                    "build-buckets-vs-ring",
                     "decode_strategy='ring' already pipelines transfer against "
                     "decode over ppermute hops; combining it with bucket_bytes "
                     "would nest two pipelines and the bucketing would be "
@@ -340,14 +345,16 @@ class GradientExchanger:
                     "with bucket_bytes, or ring without it"
                 )
             if cfg.deepreduce is None and cfg.compressor == "none":
-                raise ValueError(
+                raise ConfigError(
+                    "build-buckets-need-compression",
                     "bucket_bytes only affects the compressed allgather path; "
                     "the dense baseline (deepreduce=None, compressor='none') "
                     "is a psum and would silently ignore it — set "
                     "bucket_bytes=None for dense runs"
                 )
             if cfg.layer_pattern is not None:
-                raise ValueError(
+                raise ConfigError(
+                    "build-buckets-vs-layer-pattern",
                     "layer_pattern excludes leaves BY NAME from compression, "
                     "but fused buckets dissolve leaf identity (one codec spans "
                     "many leaves) so the pattern would be silently ignored — "
@@ -367,7 +374,8 @@ class GradientExchanger:
             )
         else:
             if bucket_points is not None:
-                raise ValueError(
+                raise ConfigError(
+                    "build-bucket-points-need-buckets",
                     "bucket_points is the adaptive controller's per-bucket "
                     "(ratio, fpr) vector for the BUCKETED exchange and would "
                     "be silently ignored without bucket_bytes — set "
@@ -396,7 +404,8 @@ class GradientExchanger:
             and self._layouts is None
             and self._bucketed is None
         ):
-            raise ValueError(
+            raise ConfigError(
+                "build-decode-strategy-needs-fused-allgather",
                 f"decode_strategy={cfg.decode_strategy!r} restructures the "
                 "FUSED allgather decode and would be silently ignored here "
                 f"(communicator={cfg.communicator!r}, fused={cfg.fused}) — "

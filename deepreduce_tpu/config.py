@@ -13,6 +13,130 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
+# ------------------------------------------------------------------------ #
+# machine-readable rejection codes
+#
+# Every configuration rejection — the __post_init__ raises below and the
+# construction-time raises in comm.py — carries one of these codes, so the
+# composition-lattice auditor (analysis/lattice.py) can partition the
+# feature cross-product into LEGAL/REJECTED cells keyed by code instead of
+# scraping prose. The human-readable message stays primary; the code rides
+# at the end as `[reason_code=...]`. Codes are registered here so a typo'd
+# code fails at raise time and the MATRIX.json code set can be asserted to
+# be a subset of this registry.
+# ------------------------------------------------------------------------ #
+
+REASON_CODES: Dict[str, str] = {
+    # enum membership (config.check): one code per enumerated field
+    "enum-compressor": "compressor not in COMPRESSORS",
+    "enum-memory": "memory not in MEMORIES",
+    "enum-communicator": "communicator not in COMMUNICATORS",
+    "enum-deepreduce": "deepreduce not in DEEPREDUCE_MODES",
+    "enum-policy": "policy not in POLICIES",
+    "enum-value": "value not in VALUE_CODECS",
+    "enum-index": "index not in INDEX_CODECS",
+    "enum-bloom_blocked": "bloom_blocked not in BLOOM_BLOCKED",
+    "enum-rs_mode": "rs_mode not in RS_MODES",
+    "enum-bucket_order": "bucket_order not in BUCKET_ORDERS",
+    "enum-hier_ici": "hier_ici not in HIER_ICI_LEGS",
+    "enum-hier_dcn": "hier_dcn not in HIER_DCN_MODES",
+    "enum-decode_strategy": "decode_strategy not in ('loop', 'vmap', 'ring')",
+    # scalar range checks
+    "rs-block-size-range": "rs_block_size must be a positive multiple of 4",
+    "rs-density-threshold-range": "rs_density_threshold outside [0, 1]",
+    "rs-sketch-rows-range": "rs_sketch_rows < 1",
+    "rs-sketch-cols-range": "rs_sketch_cols < 0",
+    "decode-batch-range": "decode_batch < 1",
+    "telemetry-every-range": "telemetry_every < 1",
+    "bucket-bytes-range": "bucket_bytes < 4 (one f32 element)",
+    "ici-size-range": "ici_size < 1",
+    "resilience-rate-range": "a drop/chaos rate outside [0, 1]",
+    "ctrl-target-range": "ctrl_target_err_cos outside (0, 1]",
+    "ctrl-headroom-range": "ctrl_headroom < 0",
+    "ctrl-saturation-range": "ctrl_saturation_ceiling < 0",
+    "ctrl-hysteresis-range": "ctrl_hysteresis < 1",
+    "fed-population-range": "fed_num_clients <= 0 with fed=True",
+    "fed-cohort-range": "fed_clients_per_round <= 0 with fed=True",
+    "fed-cohort-exceeds-population": "cohort larger than the population",
+    "fed-local-steps-range": "fed_local_steps <= 0",
+    "fed-server-lr-range": "fed_server_lr <= 0",
+    "fed-client-chunk-range": "fed_client_chunk < 0",
+    "fed-chunk-divides-cohort": "fed_client_chunk does not divide the cohort",
+    # feature-exclusion constraints (the legality matrix proper)
+    "rs-mode-needs-sparse-rs": "rs_mode set without communicator='sparse_rs'",
+    "bucket-order-needs-buckets": "bucket_order set without bucket_bytes",
+    "stream-needs-buckets": "stream_exchange without bucket_bytes",
+    "stream-vs-resilience": "stream_exchange cannot thread resilience state",
+    "stream-vs-hier": "stream_exchange cannot split the two-leg hier schedule",
+    "stream-vs-fed": "stream_exchange hooks a path the fed round never runs",
+    "resilience-knobs-disengaged": "resilience knob(s) without resilience=True",
+    "resilience-vs-owner-communicator":
+        "participation mask cannot mask shard ownership (qar/sparse_rs)",
+    "chaos-needs-checksum": "chaos injection without payload_checksum",
+    "checksum-needs-fused-allgather":
+        "payload_checksum outside the fused allgather wire format",
+    "hier-knobs-disengaged": "hier knob(s) without hier=True",
+    "hier-vs-ring": "ring hop schedule addresses ici replicas under hier",
+    "hier-vs-resilience": "per-worker mask cannot mask a slice-mean psum",
+    "hier-dcn-auto-needs-topk":
+        "hier_dcn='auto' rewrites among plain top-k routes only",
+    "fed-knobs-disengaged": "fed_* knob(s) without fed=True",
+    "fed-vs-hier": "the fed round ignores the hierarchical exchange",
+    "fed-vs-communicator":
+        "the fed round aggregates via ONE fused psum; communicator unused",
+    "fed-vs-buckets": "the fed round's TreeCodec path ignores bucket_bytes",
+    "fed-vs-decode-strategy":
+        "the fed round has no gathered-worker decode to restructure",
+    "ctrl-knobs-disengaged": "ctrl_* knob(s) without ctrl=True",
+    "ctrl-needs-telemetry": "ctrl=True without telemetry=True",
+    "ctrl-needs-compressor": "ctrl=True with compressor='none'",
+    "ctrl-vs-hier-fed": "ctrl drives the flat exchanger only",
+    "profile-needs-auto-selector": "profile without any 'auto' selector",
+    "profile-vs-ctrl": "profile and ctrl both own the operating point",
+    # syntax checks delegated to the owning subsystem's parser
+    "fault-plan-syntax": "fault_plan failed FaultPlan.parse",
+    "ctrl-ladder-syntax": "ctrl_ladder failed Ladder.parse",
+    # exchanger-construction rejections (comm.py): combos the config cannot
+    # see alone (they need the fused/bucketed build context)
+    "build-qar-codec-stack":
+        "qar quantizes in-collective; codec/memory stack would be ignored",
+    "build-sparse-rs-codec-stack":
+        "sparse_rs routes its own top-k; codec stack would be ignored",
+    "build-rs-auto-needs-workers": "rs_mode='auto' needs the static mesh size",
+    "build-buckets-need-fused-allgather":
+        "bucket_bytes outside the fused allgather exchange",
+    "build-buckets-vs-ring": "bucket_bytes would nest two pipelines under ring",
+    "build-buckets-need-compression": "bucket_bytes on the dense psum baseline",
+    "build-buckets-vs-layer-pattern": "fused buckets dissolve leaf identity",
+    "build-bucket-points-need-buckets": "bucket_points without bucket_bytes",
+    "build-decode-strategy-needs-fused-allgather":
+        "vmap/ring decode outside the fused allgather exchange",
+}
+
+
+class ConfigError(ValueError):
+    """A rejected configuration, tagged with a machine-readable reason code.
+
+    Subclasses ValueError so every existing `except ValueError` /
+    `pytest.raises(ValueError, match=...)` contract keeps working; the code
+    is appended to the message and exposed as `.reason_code` for the
+    composition-lattice auditor."""
+
+    def __init__(self, reason_code: str, message: str):
+        if reason_code not in REASON_CODES:
+            raise AssertionError(
+                f"unregistered reason_code {reason_code!r} — add it to "
+                "config.REASON_CODES"
+            )
+        super().__init__(f"{message} [reason_code={reason_code}]")
+        self.reason_code = reason_code
+
+
+def reason_code_of(exc: BaseException) -> Optional[str]:
+    """The machine-readable rejection code of a config/build error, or None
+    for a plain (uncoded) exception."""
+    return getattr(exc, "reason_code", None)
+
 
 @dataclasses.dataclass(frozen=True)
 class DeepReduceConfig:
@@ -317,8 +441,9 @@ class DeepReduceConfig:
     def __post_init__(self):
         def check(name, value, allowed):
             if value not in allowed:
-                raise ValueError(
-                    f"{name} must be one of {allowed}, got {value!r}"
+                raise ConfigError(
+                    f"enum-{name}",
+                    f"{name} must be one of {allowed}, got {value!r}",
                 )
 
         check("compressor", self.compressor, self.COMPRESSORS)
@@ -334,50 +459,62 @@ class DeepReduceConfig:
         check("bloom_blocked", self.bloom_blocked, self.BLOOM_BLOCKED)
         check("rs_mode", self.rs_mode, self.RS_MODES)
         if self.rs_mode != "sparse" and self.communicator != "sparse_rs":
-            raise ValueError(
+            raise ConfigError(
+                "rs-mode-needs-sparse-rs",
                 f"rs_mode={self.rs_mode!r} selects a sparse_rs route and "
                 "would be silently ignored with "
                 f"communicator={self.communicator!r} — use "
                 "communicator='sparse_rs' (or drop rs_mode)"
             )
         if self.rs_block_size < 4 or self.rs_block_size % 4:
-            raise ValueError(
+            raise ConfigError(
+                "rs-block-size-range",
                 "rs_block_size must be a positive multiple of 4 (int8 levels "
                 f"ride bitcast 4-per-f32-lane), got {self.rs_block_size}"
             )
         if not 0.0 <= self.rs_density_threshold <= 1.0:
-            raise ValueError(
+            raise ConfigError(
+                "rs-density-threshold-range",
                 "rs_density_threshold is a live fraction of the reduced "
                 f"shard and must be in [0, 1], got {self.rs_density_threshold}"
             )
         if self.rs_sketch_rows < 1:
-            raise ValueError(
+            raise ConfigError(
+                "rs-sketch-rows-range",
                 f"rs_sketch_rows must be >= 1, got {self.rs_sketch_rows}"
             )
         if self.rs_sketch_cols < 0:
-            raise ValueError(
+            raise ConfigError(
+                "rs-sketch-cols-range",
                 "rs_sketch_cols must be >= 1, or 0 to auto-size (~2k/rows), "
                 f"got {self.rs_sketch_cols}"
             )
         if self.decode_strategy not in ("loop", "vmap", "ring"):
-            raise ValueError(
+            raise ConfigError(
+                "enum-decode_strategy",
                 f"decode_strategy must be 'loop', 'vmap' or 'ring', got "
                 f"{self.decode_strategy!r}"
             )
         if self.decode_batch < 1:
-            raise ValueError(f"decode_batch must be >= 1, got {self.decode_batch}")
+            raise ConfigError(
+                "decode-batch-range",
+                f"decode_batch must be >= 1, got {self.decode_batch}"
+            )
         if self.telemetry_every < 1:
-            raise ValueError(
+            raise ConfigError(
+                "telemetry-every-range",
                 f"telemetry_every must be >= 1, got {self.telemetry_every}"
             )
         if self.bucket_bytes is not None and self.bucket_bytes < 4:
-            raise ValueError(
+            raise ConfigError(
+                "bucket-bytes-range",
                 "bucket_bytes must be >= 4 (one f32 element) or None, got "
                 f"{self.bucket_bytes}"
             )
         check("bucket_order", self.bucket_order, self.BUCKET_ORDERS)
         if self.bucket_order != "trace" and self.bucket_bytes is None:
-            raise ValueError(
+            raise ConfigError(
+                "bucket-order-needs-buckets",
                 f"bucket_order={self.bucket_order!r} orders the bucketed "
                 "exchange's partition and would be silently ignored with "
                 "bucket_bytes=None — set bucket_bytes (or drop bucket_order)"
@@ -385,7 +522,8 @@ class DeepReduceConfig:
         # --- streaming exchange: loud failure for silently-ignored or
         # --- structurally impossible combinations ---
         if self.stream_exchange and self.bucket_bytes is None:
-            raise ValueError(
+            raise ConfigError(
+                "stream-needs-buckets",
                 "stream_exchange=True streams the BUCKETED exchange out of "
                 "the backward pass (one custom_vjp hook per bucket) — with "
                 "bucket_bytes=None there is no bucket partition to stream. "
@@ -400,7 +538,8 @@ class DeepReduceConfig:
             # bucket (and the checksum-failure counter is accumulated
             # across buckets in one spot). Until the hooks learn to thread
             # resilience state, the combination fails loudly here.
-            raise ValueError(
+            raise ConfigError(
+                "stream-vs-resilience",
                 "stream_exchange=True dispatches each bucket from inside a "
                 "custom_vjp backward rule, which does not thread the "
                 "resilience subsystem's participation mask / chaos / "
@@ -414,14 +553,16 @@ class DeepReduceConfig:
             # split BOTH legs per bucket and the ICI slice-mean psum per
             # hook. A flat streaming exchange over a multi-axis mesh (tuple
             # axis_name) works fine and is what the tests cover.
-            raise ValueError(
+            raise ConfigError(
+                "stream-vs-hier",
                 "stream_exchange=True streams the flat bucketed exchange "
                 "and cannot compose with hier=True's two-leg slice schedule "
                 "— use the flat exchange over the full mesh (a tuple "
                 "axis_name works), or hier without streaming"
             )
         if self.stream_exchange and self.fed:
-            raise ValueError(
+            raise ConfigError(
+                "stream-vs-fed",
                 "stream_exchange=True hooks the Trainer's per-step "
                 "value_and_grad; the federated round (fed=True) aggregates "
                 "client deltas through its own vmapped path and would "
@@ -434,7 +575,8 @@ class DeepReduceConfig:
         ):
             rate = getattr(self, rate_name)
             if not 0.0 <= rate <= 1.0:
-                raise ValueError(
+                raise ConfigError(
+                    "resilience-rate-range",
                     f"{rate_name} must be in [0, 1], got {rate}"
                 )
         engaged = [
@@ -450,7 +592,8 @@ class DeepReduceConfig:
             if getattr(self, name) != default
         ]
         if engaged and not self.resilience:
-            raise ValueError(
+            raise ConfigError(
+                "resilience-knobs-disengaged",
                 f"{', '.join(engaged)} configure the resilience subsystem "
                 "and would be silently ignored with resilience=False — set "
                 "resilience=True (or drop the knob(s))"
@@ -469,7 +612,8 @@ class DeepReduceConfig:
             # (one static trace, mask as traced data) rules out. allgather/
             # allreduce have no owners: a dead worker only removes its own
             # contribution, which renormalization absorbs.
-            raise ValueError(
+            raise ConfigError(
+                "resilience-vs-owner-communicator",
                 "resilience=True threads a participation mask through the "
                 "exchange, which only the allgather/allreduce communicators "
                 f"support — communicator={self.communicator!r} makes every "
@@ -483,7 +627,8 @@ class DeepReduceConfig:
             or self.chaos_truncate_rate > 0
         )
         if chaos_on and not self.payload_checksum:
-            raise ValueError(
+            raise ConfigError(
+                "chaos-needs-checksum",
                 "chaos_*_rate perturbs payloads at the wire boundary; without "
                 "payload_checksum=True the damage decodes silently (NaNs or "
                 "skewed means) instead of degrading to a counted zero "
@@ -492,7 +637,8 @@ class DeepReduceConfig:
         if self.payload_checksum and not (
             self.fused and self.communicator == "allgather"
         ):
-            raise ValueError(
+            raise ConfigError(
+                "checksum-needs-fused-allgather",
                 "payload_checksum appends a checksum word to the fused "
                 "PayloadLayout wire format and would be silently ignored here "
                 f"(communicator={self.communicator!r}, fused={self.fused}) — "
@@ -503,7 +649,10 @@ class DeepReduceConfig:
         check("hier_ici", self.hier_ici, self.HIER_ICI_LEGS)
         check("hier_dcn", self.hier_dcn, self.HIER_DCN_MODES)
         if self.ici_size is not None and self.ici_size < 1:
-            raise ValueError(f"ici_size must be >= 1 or None, got {self.ici_size}")
+            raise ConfigError(
+                "ici-size-range",
+                f"ici_size must be >= 1 or None, got {self.ici_size}"
+            )
         hier_engaged = [
             name
             for name, default in (
@@ -514,13 +663,15 @@ class DeepReduceConfig:
             if getattr(self, name) != default
         ]
         if hier_engaged and not self.hier:
-            raise ValueError(
+            raise ConfigError(
+                "hier-knobs-disengaged",
                 f"{', '.join(hier_engaged)} configure the hierarchical "
                 "exchange and would be silently ignored with hier=False — "
                 "set hier=True (or drop the knob(s))"
             )
         if self.hier and self.decode_strategy == "ring":
-            raise ValueError(
+            raise ConfigError(
+                "hier-vs-ring",
                 "hier=True cannot use decode_strategy='ring': the ring "
                 "decode issues W-1 ppermute hops sized from the FLAT worker "
                 "count, but the hierarchical DCN leg runs over the dcn axis "
@@ -542,7 +693,8 @@ class DeepReduceConfig:
             # device, the same shard-ownership argument that rejects
             # resilience over sparse_rs. Until the ICI leg learns masked
             # reduction, the combination fails loudly here.
-            raise ValueError(
+            raise ConfigError(
+                "hier-vs-resilience",
                 "resilience=True threads a per-worker participation mask "
                 "through the exchange, but hier=True exchanges per-SLICE on "
                 "the dcn axis: the ici-axis slice mean is an unmasked psum, "
@@ -554,7 +706,8 @@ class DeepReduceConfig:
         if self.hier and self.hier_dcn == "auto" and (
             self.deepreduce is not None or self.compressor != "topk"
         ):
-            raise ValueError(
+            raise ConfigError(
+                "hier-dcn-auto-needs-topk",
                 "hier_dcn='auto' rewrites the cross-slice route among the "
                 "plain top-k fused allgather and the sparse_rs routes, all "
                 "of which require compressor='topk' with no deepreduce "
@@ -567,7 +720,10 @@ class DeepReduceConfig:
             # config-free, so no cycle)
             from deepreduce_tpu.resilience.faults import FaultPlan
 
-            FaultPlan.parse(self.fault_plan)
+            try:
+                FaultPlan.parse(self.fault_plan)
+            except ValueError as e:
+                raise ConfigError("fault-plan-syntax", str(e)) from e
         # --- federated surface: loud failure for silently-ignored knobs ---
         fed_engaged = [
             name
@@ -581,7 +737,8 @@ class DeepReduceConfig:
             if getattr(self, name) != default
         ]
         if fed_engaged and not self.fed:
-            raise ValueError(
+            raise ConfigError(
+                "fed-knobs-disengaged",
                 f"{', '.join(fed_engaged)} configure the federated "
                 "simulation subsystem and would be silently ignored with "
                 "fed=False — set fed=True (or drop the knob(s))"
@@ -590,31 +747,37 @@ class DeepReduceConfig:
             # geometry checks mirror FedConfig.__post_init__ so a bad round
             # shape fails at config construction, not at driver build
             if self.fed_num_clients <= 0:
-                raise ValueError(
+                raise ConfigError(
+                    "fed-population-range",
                     "fed=True requires a positive fed_num_clients "
                     f"population, got {self.fed_num_clients}"
                 )
             if self.fed_clients_per_round <= 0:
-                raise ValueError(
+                raise ConfigError(
+                    "fed-cohort-range",
                     "fed=True requires a positive fed_clients_per_round "
                     f"cohort, got {self.fed_clients_per_round}"
                 )
             if self.fed_clients_per_round > self.fed_num_clients:
-                raise ValueError(
+                raise ConfigError(
+                    "fed-cohort-exceeds-population",
                     f"fed_clients_per_round={self.fed_clients_per_round} "
                     f"exceeds fed_num_clients={self.fed_num_clients} — "
                     "cohorts are sampled without replacement"
                 )
             if self.fed_local_steps <= 0:
-                raise ValueError(
+                raise ConfigError(
+                    "fed-local-steps-range",
                     f"fed_local_steps must be positive, got {self.fed_local_steps}"
                 )
             if self.fed_server_lr <= 0:
-                raise ValueError(
+                raise ConfigError(
+                    "fed-server-lr-range",
                     f"fed_server_lr must be positive, got {self.fed_server_lr}"
                 )
             if self.fed_client_chunk < 0:
-                raise ValueError(
+                raise ConfigError(
+                    "fed-client-chunk-range",
                     "fed_client_chunk must be >= 0 (0 = one vmap block), "
                     f"got {self.fed_client_chunk}"
                 )
@@ -622,10 +785,51 @@ class DeepReduceConfig:
                 self.fed_client_chunk > 0
                 and self.fed_clients_per_round % self.fed_client_chunk
             ):
-                raise ValueError(
+                raise ConfigError(
+                    "fed-chunk-divides-cohort",
                     f"fed_client_chunk={self.fed_client_chunk} must divide "
                     f"fed_clients_per_round={self.fed_clients_per_round} "
                     "(the chunked cohort scan needs equal blocks)"
+                )
+            # the fed round never builds a GradientExchanger: aggregation is
+            # ONE fused psum of the vmapped client deltas, and compression
+            # rides the path-keyed TreeCodec pair (fedsim/round.py). Knobs
+            # that only restructure the flat gathered-worker exchange would
+            # be silently ignored — fail loudly, same contract as the
+            # resilience/hier/ctrl fences above.
+            if self.hier:
+                raise ConfigError(
+                    "fed-vs-hier",
+                    "fed=True aggregates client deltas through the fedsim "
+                    "round's single fused psum; the hierarchical two-leg "
+                    "exchange (hier=True) would be silently ignored — drop "
+                    "one of the two"
+                )
+            if self.communicator != "allgather":
+                raise ConfigError(
+                    "fed-vs-communicator",
+                    f"communicator={self.communicator!r} selects a gathered-"
+                    "worker exchange the federated round never runs (its "
+                    "aggregate is ONE fused psum; compression is the "
+                    "TreeCodec pair) — keep the default communicator="
+                    "'allgather' with fed=True"
+                )
+            if self.bucket_bytes is not None:
+                raise ConfigError(
+                    "fed-vs-buckets",
+                    "bucket_bytes partitions the fused gathered-worker "
+                    "exchange; the federated round compresses per leaf "
+                    "through the path-keyed TreeCodec and would silently "
+                    "ignore it — use bucket_bytes=None with fed=True"
+                )
+            if self.decode_strategy != "loop":
+                raise ConfigError(
+                    "fed-vs-decode-strategy",
+                    f"decode_strategy={self.decode_strategy!r} restructures "
+                    "the gathered-worker decode of the flat exchange; the "
+                    "federated round decodes one summed TreeCodec payload "
+                    "and would silently ignore it — keep the default 'loop' "
+                    "with fed=True"
                 )
         # --- adaptive controller: loud failure for silently-ignored knobs ---
         ctrl_engaged = [
@@ -640,45 +844,53 @@ class DeepReduceConfig:
             if getattr(self, name) != default
         ]
         if ctrl_engaged and not self.ctrl:
-            raise ValueError(
+            raise ConfigError(
+                "ctrl-knobs-disengaged",
                 f"{', '.join(ctrl_engaged)} configure the adaptive "
                 "compression controller and would be silently ignored with "
                 "ctrl=False — set ctrl=True (or drop the knob(s))"
             )
         if self.ctrl:
             if not self.telemetry:
-                raise ValueError(
+                raise ConfigError(
+                    "ctrl-needs-telemetry",
                     "ctrl=True requires telemetry=True: the controller "
                     "consumes the MetricAccumulators fetch and adds no "
                     "syncs of its own"
                 )
             if self.compressor == "none":
-                raise ValueError(
+                raise ConfigError(
+                    "ctrl-needs-compressor",
                     "ctrl=True has nothing to tune with compressor='none' "
                     "(no sparsifier budget); pick a sparsifying compressor"
                 )
             if self.hier or self.fed:
-                raise ValueError(
+                raise ConfigError(
+                    "ctrl-vs-hier-fed",
                     "ctrl=True currently drives the flat GradientExchanger "
                     "only — it cannot rebuild the hierarchical or federated "
                     "pipelines per rung (hier=False, fed=False required)"
                 )
             if not 0.0 < self.ctrl_target_err_cos <= 1.0:
-                raise ValueError(
+                raise ConfigError(
+                    "ctrl-target-range",
                     "ctrl_target_err_cos must be in (0, 1], got "
                     f"{self.ctrl_target_err_cos}"
                 )
             if self.ctrl_headroom < 0.0:
-                raise ValueError(
+                raise ConfigError(
+                    "ctrl-headroom-range",
                     f"ctrl_headroom must be >= 0, got {self.ctrl_headroom}"
                 )
             if self.ctrl_saturation_ceiling < 0.0:
-                raise ValueError(
+                raise ConfigError(
+                    "ctrl-saturation-range",
                     "ctrl_saturation_ceiling must be >= 0, got "
                     f"{self.ctrl_saturation_ceiling}"
                 )
             if self.ctrl_hysteresis < 1:
-                raise ValueError(
+                raise ConfigError(
+                    "ctrl-hysteresis-range",
                     f"ctrl_hysteresis must be >= 1, got {self.ctrl_hysteresis}"
                 )
             # ladder syntax check at construction (deferred import:
@@ -686,7 +898,10 @@ class DeepReduceConfig:
             # here to avoid the cycle — mirrors the FaultPlan.parse idiom)
             from deepreduce_tpu.controller.ladder import Ladder
 
-            Ladder.parse(self.ctrl_ladder)
+            try:
+                Ladder.parse(self.ctrl_ladder)
+            except ValueError as e:
+                raise ConfigError("ctrl-ladder-syntax", str(e)) from e
         # --- fitted machine profile: must have a selector to re-select ------
         if self.profile is not None:
             has_auto = (
@@ -695,14 +910,16 @@ class DeepReduceConfig:
                 or self.hier_dcn == "auto"
             )
             if not has_auto:
-                raise ValueError(
+                raise ConfigError(
+                    "profile-needs-auto-selector",
                     f"profile={self.profile!r} re-prices the 'auto' plan "
                     "selection and would be silently ignored with every "
                     "selector explicit — set rs_mode='auto' or "
                     "hier_ici/hier_dcn='auto' (or drop profile)"
                 )
             if self.ctrl:
-                raise ValueError(
+                raise ConfigError(
+                    "profile-vs-ctrl",
                     "profile with ctrl=True would fight the adaptive "
                     "controller for the operating point — calibrate the "
                     "construction-time plan (profile) or adapt at runtime "

@@ -191,6 +191,17 @@ class FedSim:
         (p_end, _), _ = jax.lax.scan(one_step, (params, opt_state), batches)
         return p_end
 
+    def build_layout(self, params_like: Any) -> None:
+        """Derive the checksum/chaos uplink payload layout from param
+        shapes alone — the piece of `init` that trace-only callers (the
+        analysis gate on an abstract mesh) need, without allocating the
+        residual bank on real devices. Accepts arrays or ShapeDtypeStructs."""
+        sds = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params_like
+        )
+        payload_sds, _ = self.tc_c2s.payload_sds(sds)
+        self._layout = PayloadLayout(payload_sds, checksum=self.checksum)
+
     def init(self, params: Any) -> FedSimState:
         params = jax.tree_util.tree_map(jnp.asarray, params)
         bank = None
@@ -211,11 +222,7 @@ class FedSim:
                 bank = _zeros()
         acc = MetricAccumulators.zeros() if self.cfg_c2s.telemetry else None
         if self.checksum or self.chaos is not None:
-            sds = jax.tree_util.tree_map(
-                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
-            )
-            payload_sds, _ = self.tc_c2s.payload_sds(sds)
-            self._layout = PayloadLayout(payload_sds, checksum=self.checksum)
+            self.build_layout(params)
         self._round = self._build(params)
         return FedSimState(
             params=params,
@@ -364,11 +371,11 @@ class FedSim:
         bank on real devices); the checksum/chaos uplink stage still needs
         `init` first, since the payload layout is derived there."""
         if self._round is None:
-            if self.checksum or self.chaos is not None:
+            if (self.checksum or self.chaos is not None) and self._layout is None:
                 raise RuntimeError(
-                    "call init(params) before sharded_round_fn() when "
-                    "payload_checksum/chaos is engaged — the uplink layout "
-                    "is built from the param shapes in init"
+                    "call init(params) or build_layout(params_like) before "
+                    "sharded_round_fn() when payload_checksum/chaos is "
+                    "engaged — the uplink layout is built from param shapes"
                 )
             self._round = self._build(None)
         return self._round.__wrapped__  # the pre-jit callable

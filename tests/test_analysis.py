@@ -398,3 +398,352 @@ def test_from_params_strict():
     # reference-spelled aliases still map in strict mode
     cfg = from_params({"threshold": 0.5, "micro-benchmark": True}, strict=True)
     assert cfg.threshold_val == 0.5 and cfg.micro_benchmark
+
+
+# ---------------------------------------------------------------------- #
+# dataflow negative fixtures — each SPMD rule fires, alone, with its id
+# ---------------------------------------------------------------------- #
+
+
+def test_collective_under_cond_caught():
+    """A collective nested under a data-dependent lax.cond deadlocks the
+    moment workers disagree on the predicate — caught statically."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = audit_mesh()
+
+    def spmd(x):
+        def yes(v):
+            return jax.lax.psum(v, AXIS)
+
+        def no(v):
+            return v
+
+        out = jax.lax.cond(x[0, 0] > 0.0, yes, no, x[0])
+        return out[None]
+
+    fn = shard_map(spmd, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, 64), jnp.float32))
+    v = _only(
+        run_rules(closed, AuditContext(label="fixture:cond-collective")),
+        rules.R_COLLECTIVE_SCHEDULE,
+    )
+    assert "cond" in v.detail
+
+
+def test_collective_in_scan_is_legal():
+    """The ring decode's per-step ppermute lives inside a fori_loop (a
+    scan with a FIXED trip count) — that is schedulable and must NOT trip
+    the rule; only data-dependent branching is a deadlock hazard."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = audit_mesh()
+
+    def spmd(x):
+        def body(i, acc):
+            return acc + jax.lax.ppermute(
+                x[0], AXIS, [(j, (j + 1) % 8) for j in range(8)]
+            )
+
+        return jax.lax.fori_loop(0, 4, body, jnp.zeros_like(x[0]))[None]
+
+    fn = shard_map(spmd, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, 64), jnp.float32))
+    assert run_rules(closed, AuditContext(label="fixture:scan-ok")) == []
+
+
+def test_broken_token_chain_caught():
+    """A 'streaming' exchange whose all_gather is not pinned between
+    optimization_barriers can be hoisted by XLA to a bulk tail — the
+    barrier census (2 per bucket) and dominance check catch it."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = audit_mesh()
+
+    def spmd(x):
+        return jax.lax.all_gather(x[0], AXIS).sum(axis=0)[None]
+
+    fn = shard_map(spmd, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, 64), jnp.float32))
+    ctx = AuditContext(label="fixture:no-tokens", expect_stream_buckets=1)
+    _only(run_rules(closed, ctx), rules.R_TOKEN_DOMINANCE)
+
+
+def test_read_after_donation_caught():
+    """An equation consuming a donated buffer after its aliased output is
+    live reads freed memory under XLA aliasing."""
+
+    @jax.jit
+    def inner(x):
+        return x * 2.0
+
+    donating = jax.jit(lambda x: x + 1.0, donate_argnums=0)
+
+    def bad(x):
+        y = donating(x)
+        return y + x  # x was donated to `y` — stale read
+
+    closed = jax.make_jaxpr(bad)(jax.ShapeDtypeStruct((64,), jnp.float32))
+    _only(
+        run_rules(closed, AuditContext(label="fixture:donation")),
+        rules.R_DONATION,
+    )
+
+    def ok(x):
+        z = inner(x) + x  # reads BEFORE the donating call
+        return donating(x) + z.sum()
+
+    closed_ok = jax.make_jaxpr(ok)(jax.ShapeDtypeStruct((64,), jnp.float32))
+    assert run_rules(closed_ok, AuditContext(label="fixture:donation-ok")) == []
+
+
+def test_reused_prng_key_caught():
+    """Two draws from one fold signature produce correlated 'noise' —
+    silent statistical corruption, caught by signature collision."""
+
+    def bad(key):
+        k = jax.random.fold_in(key, 7)
+        return jax.random.normal(k, (3,)) + jax.random.normal(k, (3,))
+
+    closed = jax.make_jaxpr(bad)(jax.random.PRNGKey(0))
+    ctx = AuditContext(label="fixture:key-reuse", require_key_lineage=True)
+    v = _only(run_rules(closed, ctx), rules.R_KEY_LINEAGE)
+    assert "share one fold signature" in v.detail
+
+
+def test_unfolded_key_draw_caught():
+    """A draw straight from the step key (no worker/tensor fold) gives
+    every worker identical 'noise' — the per-trace fold discipline."""
+
+    def bad(key):
+        return jax.random.normal(key, (3,))
+
+    closed = jax.make_jaxpr(bad)(jax.random.PRNGKey(0))
+    ctx = AuditContext(label="fixture:unfolded", require_key_lineage=True)
+    v = _only(run_rules(closed, ctx), rules.R_KEY_LINEAGE)
+    assert "never passed through fold_in" in v.detail
+
+    def ok(key):
+        k1 = jax.random.fold_in(jax.random.fold_in(key, 0), 1)
+        k2 = jax.random.fold_in(jax.random.fold_in(key, 0), 2)
+        ka, kb = jax.random.split(jax.random.fold_in(key, 9))
+        return (jax.random.normal(k1, (3,)) + jax.random.normal(k2, (3,))
+                + jax.random.normal(ka, (3,)) + jax.random.normal(kb, (3,)))
+
+    closed_ok = jax.make_jaxpr(ok)(jax.random.PRNGKey(0))
+    assert run_rules(closed_ok, AuditContext(
+        label="fixture:folds-ok", require_key_lineage=True)) == []
+
+
+def test_key_lineage_armed_per_trace():
+    """Codec unit audits legitimately receive raw keys — the rule is off
+    unless the harness arms it."""
+
+    def raw_draw(key):
+        return jax.random.normal(key, (3,))
+
+    closed = jax.make_jaxpr(raw_draw)(jax.random.PRNGKey(0))
+    assert run_rules(closed, AuditContext(label="fixture:unarmed")) == []
+
+
+# ---------------------------------------------------------------------- #
+# rule registry + CLI surface
+# ---------------------------------------------------------------------- #
+
+
+def test_rule_descriptions_cover_every_rule():
+    """--list prints one line per rule; a new rule without a description
+    (or a stale description for a removed rule) fails here."""
+    assert set(rules.RULE_DESCRIPTIONS) == set(rules.ALL_RULE_IDS)
+    assert all(rules.RULE_DESCRIPTIONS[r] for r in rules.ALL_RULE_IDS)
+
+
+def test_cli_list_and_only(monkeypatch, capsys):
+    from deepreduce_tpu.analysis import __main__ as cli
+    from deepreduce_tpu.analysis import ast_lint as al
+    from deepreduce_tpu.analysis import jaxpr_audit as ja
+
+    assert cli.main(["list"]) == 0
+    out = capsys.readouterr().out
+    for rule in rules.ALL_RULE_IDS:
+        assert rule in out
+
+    # --only gates the exit code on the named rules without shrinking the
+    # audit: a violation outside the gate still prints in the report but
+    # exits 0; inside the gate it exits 1
+    bad = rules.Violation(rules.R_F64, "fixture", "f64 fixture")
+    monkeypatch.setattr(ja, "audit_all", lambda quick=False: ([], [bad]))
+    monkeypatch.setattr(al, "lint_repo", lambda root=None: [])
+    assert cli.main(["audit", "--quick", "--out", "-",
+                     "--only", rules.R_CALLBACK]) == 0
+    assert cli.main(["audit", "--quick", "--out", "-",
+                     "--only", f"{rules.R_F64},{rules.R_CALLBACK}"]) == 1
+
+    with pytest.raises(SystemExit):
+        cli.main(["audit", "--only", "jx-not-a-rule", "--out", "-"])
+
+
+# ---------------------------------------------------------------------- #
+# the composition-lattice legality matrix
+# ---------------------------------------------------------------------- #
+
+
+def _repo_root():
+    from pathlib import Path
+
+    return Path(__file__).resolve().parents[1]
+
+
+def test_matrix_schema_and_codes_registered():
+    """The committed MATRIX.json is schema-tagged, covers the full lattice,
+    and every rejection carries a reason code registered in config."""
+    from deepreduce_tpu.analysis import lattice
+    from deepreduce_tpu.config import REASON_CODES
+
+    report = lattice.load_report(_repo_root() / "MATRIX.json")
+    assert len(report["cells"]) == lattice.n_cells()
+    assert report["axes"] == [[n, list(v)] for n, v in lattice.AXES]
+    for entry in report["entries"]:
+        if entry["status"] == "rejected":
+            assert entry["reason_code"], entry
+            assert entry["reason_code"] in REASON_CODES, entry
+        else:
+            assert entry["trace"] in report["traces"]
+    assert report["summary"]["violations"] == 0
+
+
+def test_analysis_json_schema_tagged():
+    from deepreduce_tpu.analysis import lattice
+
+    report = lattice.load_report(_repo_root() / "ANALYSIS.json")
+    assert report["jaxpr_audit"]["traces"]
+
+
+def test_load_report_rejects_foreign_schema(tmp_path):
+    import json as _json
+
+    from deepreduce_tpu.analysis import lattice
+
+    p = tmp_path / "stale.json"
+    p.write_text(_json.dumps({"schema": "other/v0"}))
+    with pytest.raises(ValueError, match="schema"):
+        lattice.load_report(p)
+    p.write_text(_json.dumps({"cells": []}))  # untagged pre-schema report
+    with pytest.raises(ValueError, match="schema"):
+        lattice.load_report(p)
+
+
+def test_config_partition_matches_committed_matrix():
+    """The config-stage legality surface, re-derived in-process cell by
+    cell (no tracing — cheap), must agree with the committed MATRIX.json:
+    same partition, same reason code, for every one of the 7680 cells."""
+    from deepreduce_tpu.analysis import lattice
+
+    report = lattice.load_report(_repo_root() / "MATRIX.json")
+    entries = report["entries"]
+    for cell, idx in zip(lattice.iter_cells(), report["cells"]):
+        committed = entries[idx]
+        part = lattice.probe_partition(cell)
+        slug = lattice._cell_slug(cell)
+        if committed["status"] == "rejected" and committed["stage"] == "config":
+            assert part[0] == "rejected", slug
+            assert part[3] == committed["reason_code"], slug
+        else:
+            # legal cells and build-stage rejections both pass config
+            assert part[0] == "legal", (slug, part)
+
+
+def test_every_config_rejection_carries_reason_code():
+    """Any ValueError out of DeepReduceConfig construction — across the
+    whole lattice AND the typo guards — carries a registered reason_code:
+    nothing is refused with prose only."""
+    from deepreduce_tpu.analysis import lattice
+    from deepreduce_tpu.config import REASON_CODES, reason_code_of
+
+    seen = set()
+    for cell in lattice.iter_cells():
+        try:
+            DeepReduceConfig(**lattice.cell_kwargs(cell))
+        except ValueError as e:
+            code = reason_code_of(e)
+            assert code is not None, lattice._cell_slug(cell)
+            assert code in REASON_CODES, code
+            seen.add(code)
+    # the committed matrix's code set is exactly what the lattice produces
+    # at config stage plus the recorded build-stage codes
+    report = lattice.load_report(_repo_root() / "MATRIX.json")
+    build_codes = {
+        e["reason_code"]
+        for e in report["entries"]
+        if e["status"] == "rejected" and e["stage"] == "build"
+    }
+    assert seen | build_codes == set(report["summary"]["reason_codes"])
+
+    with pytest.raises(ValueError) as ei:
+        DeepReduceConfig(compressor="topkk")
+    assert reason_code_of(ei.value) in REASON_CODES
+
+
+def test_trace_fingerprint_strips_host_side_knobs():
+    """ctrl/telemetry are host-side (the audited off-identity contract):
+    cells differing only by them share one memoized trace."""
+    from deepreduce_tpu.analysis import lattice
+
+    base = dict(communicator="allgather", decode="loop", buckets="off",
+                stream="off", rs_mode="sparse", hier="off", resilience="off",
+                ctrl="off", fed="off")
+    on = dict(base, ctrl="on")
+    fp_off = lattice.trace_fingerprint(lattice.cell_kwargs(base), "flat")
+    fp_on = lattice.trace_fingerprint(lattice.cell_kwargs(on), "flat")
+    assert fp_off == fp_on
+    # but a knob that DOES reach the trace splits the fingerprint
+    ring = dict(base, decode="ring")
+    assert lattice.trace_fingerprint(lattice.cell_kwargs(ring), "flat") != fp_off
+
+
+def test_matrix_cli_drift_detection(monkeypatch, tmp_path):
+    """`analysis matrix` exits 0 against a faithful baseline, 1 when a
+    cell's legality, reason code, or trace hash drifts — without re-probing
+    the lattice (build_matrix is stubbed with the committed report)."""
+    import copy
+    import json as _json
+
+    from deepreduce_tpu.analysis import __main__ as cli
+    from deepreduce_tpu.analysis import lattice
+
+    committed = lattice.load_report(_repo_root() / "MATRIX.json")
+    monkeypatch.setattr(
+        lattice, "build_matrix", lambda progress=None: copy.deepcopy(committed)
+    )
+    baseline = tmp_path / "MATRIX.json"
+    lattice.write_matrix(committed, baseline)
+    assert cli.main(["matrix", "--out", str(baseline)]) == 0
+
+    # drift one rejected cell's reason code in the baseline
+    drifted = copy.deepcopy(committed)
+    for e in drifted["entries"]:
+        if e["status"] == "rejected":
+            e["reason_code"] = "f64-requires-opt-in"
+            break
+    lattice.write_matrix(drifted, baseline)
+    assert cli.main(["matrix", "--out", str(baseline)]) == 1
+
+    # a missing baseline bootstraps (exit 0) and writes the file
+    fresh = tmp_path / "bootstrap.json"
+    assert cli.main(["matrix", "--out", str(fresh)]) == 0
+    assert _json.loads(fresh.read_text())["schema"] == lattice.SCHEMA
+
+
+@pytest.mark.slow
+def test_full_matrix_regenerates_without_drift():
+    """The heavyweight gate: re-probe the whole lattice (config + build +
+    trace of every legal cell) and diff against the committed artifact."""
+    from deepreduce_tpu.analysis import lattice
+
+    report = lattice.build_matrix()
+    assert report["violations"] == [], report["violations"][:5]
+    baseline = lattice.load_report(_repo_root() / "MATRIX.json")
+    assert lattice.compare_matrix(baseline, report) == []

@@ -704,7 +704,7 @@ def rs_sweep(quick: bool = False, workers: int = 8) -> dict:
     )
     key = jax.random.PRNGKey(0)
 
-    rs_modes = ("sparse", "adaptive", "quantized", "sketch")
+    rs_modes = ("sparse", "adaptive", "quantized", "sketch", "oktopk")
     compute = {}
     for mode in rs_modes:
 
@@ -820,6 +820,141 @@ def rs_sweep(quick: bool = False, workers: int = 8) -> dict:
                 for n, m in bloom_rows.items()
             },
             **comparison,
+        },
+    }
+
+
+def oktopk_sweep(quick: bool = False, workers: int = 8) -> dict:
+    """The Ok-Topk density x W grid arm (`--oktopk-sweep`, committed as
+    BENCH_OKTOPK_r18.json): measure the oktopk route's per-step compute for
+    real on the virtual CPU mesh at one anchor point (next to quantized and
+    sparse, same wall/W amortization as `rs_sweep`), then price the full
+    density x worker-count grid with the same W-aware ring model
+    `select_rs_mode` argmins over. Every grid point is wire-only
+    (t_compute_s=0) — exactly the selector's view, so `auto_selects` and
+    the per-mode step times in a point agree by construction. Each point
+    carries d/ratio/workers so `telemetry compare --profile` can re-price
+    the whole grid under a fitted MachineProfile."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepreduce_tpu import sparse_rs
+    from deepreduce_tpu.utils import enable_compile_cache
+    from deepreduce_tpu.utils.compat import shard_map
+
+    enable_compile_cache()
+    cm = _costmodel()
+    d = LSTM_D if not quick else 500_000
+    anchor_ratio = 0.01  # the sparse regime the oktopk route targets
+    W = workers
+    mesh = Mesh(np.array(jax.devices()[:W]), ("data",))
+    rng = np.random.default_rng(0)
+    g = jnp.asarray(
+        (rng.normal(size=(W, d)) * rng.random((W, d)) ** 2).astype(np.float32)
+    )
+    key = jax.random.PRNGKey(0)
+
+    compute = {}
+    for mode in ("sparse", "quantized", "oktopk"):
+
+        def spmd(gw, mode=mode):
+            agg, own, _ = sparse_rs.exchange(
+                gw[0],
+                "data",
+                W,
+                ratio=anchor_ratio,
+                rs_mode=mode,
+                key=(key if mode in ("adaptive", "quantized") else None),
+            )
+            return agg[None]
+
+        fn = jax.jit(
+            shard_map(
+                spmd,
+                mesh=mesh,
+                in_specs=(P("data"),),
+                out_specs=P("data"),
+                check_vma=False,
+            )
+        )
+        _progress(f"oktopk-sweep: compiling rs_mode={mode} (d={d}, W={W})")
+        with _span(f"bench/oktopk-sweep/compile/{mode}"):
+            _sync(fn(g))
+        _progress(f"oktopk-sweep: timing rs_mode={mode}")
+        with _span(f"bench/oktopk-sweep/time/{mode}"):
+            wall = _timeit(fn, g, iters=2 if quick else 3, reps=3)
+        compute[mode] = wall / W
+        _progress(
+            f"oktopk-sweep: {mode} wall={wall:.4f}s compute/worker={wall / W:.4f}s"
+        )
+
+    ratios = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1)
+    worker_grid = (8, 16, 32)
+    modes = ("sparse", "adaptive", "quantized", "sketch", "oktopk")
+    points = []
+    oktopk_wins = 0
+    sparse_regime_wins = 0
+    sparse_regime_pts = 0
+    for r in ratios:
+        for Wm in worker_grid:
+            step = {m: cm.rs_step_time(m, d, Wm, r) for m in modes}
+            pick = cm.select_rs_mode(d, Wm, r)
+            speedup = step["quantized"] / step["oktopk"]
+            if pick == "oktopk":
+                oktopk_wins += 1
+            if r <= 0.01:
+                sparse_regime_pts += 1
+                if step["oktopk"] < step["quantized"]:
+                    sparse_regime_wins += 1
+            points.append(
+                {
+                    "d": d,
+                    "ratio": r,
+                    "workers": Wm,
+                    "modeled_step_s": {
+                        m: round(v, 6) for m, v in step.items()
+                    },
+                    "auto_selects": pick,
+                    "speedup_oktopk_vs_quantized": round(speedup, 3),
+                    # the exact per-collective injection bytes the
+                    # jx-wire-accounting 'collective' rule pins on the trace
+                    "oktopk_wire_bytes_per_collective": cm.rs_wire_bytes(
+                        "oktopk", d, Wm, r
+                    ),
+                }
+            )
+
+    return {
+        "metric": "oktopk_vs_quantized_modeled_step_time_grid",
+        "unit": "s",
+        "platform": "cpu",
+        "provenance": _provenance(
+            modeled=["detail.points", "detail.headline"],
+            measured=["detail.oktopk_compute_anchor"],
+        ),
+        "detail": {
+            "model": "stackoverflow_lstm" if not quick else "quick",
+            "d": d,
+            "workers_measured": W,
+            "anchor_ratio": anchor_ratio,
+            "bw_bytes_per_s": cm.BW_100MBPS,
+            "cost_model": (
+                "W-aware ring model (costmodel.rs_step_time), wire-only grid"
+                " — the same argmin select_rs_mode('auto') runs; compute"
+                " anchor measured on the CPU mesh at anchor_ratio"
+            ),
+            "oktopk_compute_anchor": {
+                n: round(v, 4) for n, v in compute.items()
+            },
+            "headline": {
+                "oktopk_auto_picks": oktopk_wins,
+                "grid_points": len(points),
+                "oktopk_beats_quantized_at_ratio_le_0.01": (
+                    f"{sparse_regime_wins}/{sparse_regime_pts}"
+                ),
+            },
+            "points": points,
         },
     }
 
@@ -1381,6 +1516,14 @@ def main() -> None:
 
         force_platform("cpu")
         print(json.dumps(rs_sweep(quick="--quick" in sys.argv)))
+        return
+    if "--oktopk-sweep" in sys.argv:
+        # standalone Ok-Topk density x W grid mode: CPU-mesh only, one JSON
+        # record on stdout (committed as BENCH_OKTOPK_*.json)
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu")
+        print(json.dumps(oktopk_sweep(quick="--quick" in sys.argv)))
         return
     if "--bucketed-sweep" in sys.argv:
         # standalone bucketed-exchange mode: CPU-mesh only, one JSON record

@@ -1263,6 +1263,23 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
     # pre-existing record order — and ANALYSIS.json hashes — are stable) ---
     add("exchange:streaming", lambda: audit_streaming_exchange())
     add("calib:reselect", lambda: audit_calib_reselect())
+    # --- the r18 oktopk balanced route (registered last so the pre-existing
+    # record order — and ANALYSIS.json hashes — are stable) ---
+    add(
+        "exchange:sparse_rs-oktopk",
+        lambda: audit_exchange(
+            "exchange:sparse_rs-oktopk",
+            C(communicator="sparse_rs", compressor="topk", memory="none",
+              deepreduce=None, compress_ratio=0.02, rs_mode="oktopk"),
+            # ONE psum of the f32[bins] magnitude histogram (the global
+            # threshold pass) + the balanced all_to_all of surviving
+            # (val, idx) pairs + phase-2 all_gather of the re-selected
+            # top-K2 — and exact per-collective byte agreement with
+            # costmodel.rs_wire_bytes('oktopk', ...)
+            expect={"psum": 1, "all_to_all": 1, "all_gather": 1},
+            wire_mode="collective",
+        ),
+    )
     return specs
 
 

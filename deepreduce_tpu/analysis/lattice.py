@@ -48,13 +48,13 @@ SCHEMA = "deepreduce_tpu/analysis-report/v1"
 
 # (axis name, value labels) in lexicographic cell order. Every label maps
 # to concrete config kwargs in `cell_kwargs`; the cross-product is the
-# probed lattice (4*3*2*2*5*4*2*2*2 = 15360 cells).
+# probed lattice (4*3*2*2*6*4*2*2*2 = 9216 cells).
 AXES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("communicator", ("allgather", "allreduce", "qar", "sparse_rs")),
     ("decode", ("loop", "vmap", "ring")),
     ("buckets", ("off", "on")),
     ("stream", ("off", "on")),
-    ("rs_mode", ("sparse", "adaptive", "quantized", "sketch", "auto")),
+    ("rs_mode", ("sparse", "adaptive", "quantized", "sketch", "oktopk", "auto")),
     ("hier", ("off", "dense", "qar_ici", "auto_dcn")),
     ("resilience", ("off", "on")),
     ("ctrl", ("off", "on")),

@@ -309,6 +309,8 @@ class GradientExchanger:
                 block=cfg.rs_block_size,
                 rows=cfg.rs_sketch_rows,
                 cols=cfg.rs_sketch_cols,
+                bins=cfg.rs_oktopk_bins,
+                cap_headroom=cfg.rs_oktopk_cap_headroom,
                 profile=profile,
             )
         leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
@@ -814,11 +816,13 @@ class GradientExchanger:
     ) -> Tuple[Any, Any, WireStats]:
         """Compressed in-collective allreduce (sparse_rs.py — the Ok-Topk /
         SparCML collective shape, with the adaptive/quantized/sketch routes
-        of r11 behind `rs_mode`): entries routed/reduced inside the
-        collective, re-selected per shard, allgathered. Per-worker decode
-        is O(k) (or O(d·rows/W) for the sketch route) instead of the
-        allgather path's O(W·k). Residual error feedback covers send-side
-        truncation (and quantization/sketch noise in those routes)."""
+        of r11 and the balanced oktopk route of r18 behind `rs_mode`):
+        entries routed/reduced inside the collective, re-selected per
+        shard, allgathered. Per-worker decode is O(k) (or O(d·rows/W) for
+        the sketch route) instead of the allgather path's O(W·k). Residual
+        error feedback covers send-side truncation (and quantization/
+        sketch noise in those routes; sub-threshold and capacity-spilled
+        mass in the oktopk route)."""
         from deepreduce_tpu import sparse_rs
         from jax.flatten_util import ravel_pytree
 
@@ -862,6 +866,8 @@ class GradientExchanger:
                 sketch_rows=cfg.rs_sketch_rows,
                 sketch_cols=cfg.rs_sketch_cols,
                 sketch_seed=cfg.seed,
+                oktopk_bins=cfg.rs_oktopk_bins,
+                oktopk_cap_headroom=cfg.rs_oktopk_cap_headroom,
                 key=key,
                 collect=collect,
             )
@@ -972,6 +978,8 @@ class GradientExchanger:
                     block=self.cfg.rs_block_size,
                     rows=self.cfg.rs_sketch_rows,
                     cols=self.cfg.rs_sketch_cols,
+                    bins=self.cfg.rs_oktopk_bins,
+                    cap_headroom=self.cfg.rs_oktopk_cap_headroom,
                 )
             )
         if self._bucketed is not None:

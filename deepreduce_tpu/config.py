@@ -46,6 +46,9 @@ REASON_CODES: Dict[str, str] = {
     "rs-density-threshold-range": "rs_density_threshold outside [0, 1]",
     "rs-sketch-rows-range": "rs_sketch_rows < 1",
     "rs-sketch-cols-range": "rs_sketch_cols < 0",
+    "rs-oktopk-bins-range":
+        "rs_oktopk_bins not a power of two in [64, 2**24]",
+    "rs-oktopk-cap-headroom-range": "rs_oktopk_cap_headroom <= 0",
     "decode-batch-range": "decode_batch < 1",
     "telemetry-every-range": "telemetry_every < 1",
     "bucket-bytes-range": "bucket_bytes < 4 (one f32 element)",
@@ -80,6 +83,8 @@ REASON_CODES: Dict[str, str] = {
     "hier-vs-resilience": "per-worker mask cannot mask a slice-mean psum",
     "hier-dcn-auto-needs-topk":
         "hier_dcn='auto' rewrites among plain top-k routes only",
+    "rs-oktopk-vs-approx-topk":
+        "approximate candidates break the oktopk threshold-count containment",
     "fed-knobs-disengaged": "fed_* knob(s) without fed=True",
     "fed-vs-hier": "the fed round ignores the hierarchical exchange",
     "fed-vs-communicator":
@@ -212,9 +217,14 @@ class DeepReduceConfig:
     #                 per-block norms, then the sparse phase 2
     #   'sketch'    — S2-Reducer arm: count-sketched top-k summed by one
     #                 psum, per-shard unsketch, then the sparse phase 2
+    #   'oktopk'    — Ok-Topk balanced arm: psum'd magnitude histogram picks
+    #                 one global threshold (~k survivors TOTAL), survivors
+    #                 route via a W×-smaller all_to_all, then the sparse
+    #                 phase 2; spill and sub-threshold mass stay in the
+    #                 residual
     #   'auto'      — costmodel.select_rs_mode picks from (d, W, ratio) at
     #                 construction via the W-aware ring wire model
-    rs_mode: str = "sparse"  # sparse | adaptive | quantized | sketch | auto
+    rs_mode: str = "sparse"  # sparse | adaptive | quantized | sketch | oktopk | auto
     # quantization block length (elements) for the adaptive dense rows and
     # the quantized arm — one f32 norm per block on the wire. Distinct from
     # `bucket_size` (QSGD codec / qar communicator bucket length).
@@ -228,6 +238,14 @@ class DeepReduceConfig:
     # its width (0 = auto-size to ~2k/rows buckets)
     rs_sketch_rows: int = 5
     rs_sketch_cols: int = 0
+    # oktopk histogram resolution: power-of-two bucket count of the psum'd
+    # bit-pattern magnitude histogram (4096 = 16 sub-bins per f32 exponent
+    # octave, ~4% relative threshold granularity; bins*4 bytes ride the
+    # psum, so more bins = finer threshold but a larger fixed wire term)
+    rs_oktopk_bins: int = 4096
+    # oktopk per-(worker, shard) capacity multiplier over the expected
+    # k/W**2 survivor occupancy; overflow spills into the sender's residual
+    rs_oktopk_cap_headroom: float = 2.0
     use_pallas: bool = False  # pallas TPU kernels where applicable (QSGD PRNG)
     # fuse the whole pytree's payloads into ONE uint8 buffer per step and
     # run a single all_gather + one worker-decode loop, instead of one
@@ -433,7 +451,7 @@ class DeepReduceConfig:
                     "huffman")
     POLICIES = ("leftmost", "random", "p0", "conflict_sets", "conflict_sets_approx")
     BLOOM_BLOCKED = (False, True, "hash", "mod")
-    RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "auto")
+    RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "oktopk", "auto")
     HIER_ICI_LEGS = ("dense", "qar", "auto")
     HIER_DCN_MODES = ("config", "auto")
     BUCKET_ORDERS = ("trace", "reverse")
@@ -488,6 +506,29 @@ class DeepReduceConfig:
                 "rs-sketch-cols-range",
                 "rs_sketch_cols must be >= 1, or 0 to auto-size (~2k/rows), "
                 f"got {self.rs_sketch_cols}"
+            )
+        b = self.rs_oktopk_bins
+        if b < 64 or b > (1 << 24) or (b & (b - 1)) != 0:
+            raise ConfigError(
+                "rs-oktopk-bins-range",
+                "rs_oktopk_bins must be a power of two in [64, 2**24] (the "
+                "bit-pattern bucket shift needs an exact log2 and the "
+                f"histogram must fit the psum), got {b}"
+            )
+        if self.rs_oktopk_cap_headroom <= 0.0:
+            raise ConfigError(
+                "rs-oktopk-cap-headroom-range",
+                "rs_oktopk_cap_headroom scales the per-(worker, shard) "
+                f"capacity and must be > 0, got {self.rs_oktopk_cap_headroom}"
+            )
+        if self.rs_mode == "oktopk" and self.approx_topk:
+            raise ConfigError(
+                "rs-oktopk-vs-approx-topk",
+                "rs_mode='oktopk' solves its global threshold against the "
+                "psum'd candidate histogram, which is only unbiased when the "
+                "local candidate set is the EXACT top-k — approx_topk=True "
+                "can miss above-threshold entries and skew the survivor "
+                "count; use exact top-k with oktopk"
             )
         if self.decode_strategy not in ("loop", "vmap", "ring"):
             raise ConfigError(

@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 # default link every committed bench record uses.
 BW_100MBPS = 12.5e6
 
-RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "auto")
+RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "oktopk", "auto")
 
 
 # ---------------------------------------------------------------------------
@@ -377,6 +377,14 @@ def _out_budget(d: int, ratio: float, W: int, out_headroom: float) -> int:
     return min(max(1, int(math.ceil(k / W * out_headroom))), _shard_size(d, W))
 
 
+def _oktopk_budget(d: int, ratio: float, W: int, cap_headroom: float) -> int:
+    """Host-side mirror of sparse_rs.oktopk_send_budget: the global
+    threshold targets ~k survivors TOTAL, so expected per-(worker, shard)
+    occupancy is k/W² — W× below the sparse route's k/W."""
+    k = max(1, int(d * ratio))
+    return max(1, int(math.ceil(k / (W * W) * cap_headroom)))
+
+
 def sketch_cols(d: int, ratio: float, rows: int, cols: int = 0) -> int:
     """Resolved sketch width: explicit `cols` wins; 0 auto-sizes to ~2k/rows
     buckets (constant expected load factor ~1/2 per row) with a floor that
@@ -416,6 +424,8 @@ def rs_wire_bytes(
     block: int = 256,
     rows: int = 5,
     cols: int = 0,
+    bins: int = 4096,
+    cap_headroom: float = 2.0,
 ) -> Dict[str, float]:
     """Per-collective injection bytes for one sparse_rs route. Keys are the
     collective primitive names the route traces; values are the operand
@@ -437,6 +447,13 @@ def rs_wire_bytes(
     if mode == "sketch":
         C = sketch_cols(d, ratio, rows, cols)
         return {"psum": rows * C * 4.0, "all_gather": K2 * 8.0}
+    if mode == "oktopk":
+        Bo = _oktopk_budget(d, ratio, W, cap_headroom)
+        return {
+            "psum": bins * 4.0,
+            "all_to_all": W * Bo * 8.0,
+            "all_gather": K2 * 8.0,
+        }
     raise ValueError(f"unknown rs_mode {mode!r}")
 
 
@@ -483,7 +500,8 @@ def rs_step_time(
 
 def _rs_kw(kw: Dict) -> Dict:
     """Filter **kw down to the keys rs_wire_bytes understands."""
-    keep = ("headroom", "out_headroom", "block", "rows", "cols")
+    keep = ("headroom", "out_headroom", "block", "rows", "cols",
+            "bins", "cap_headroom")
     return {k: kw[k] for k in keep if k in kw}
 
 
@@ -497,6 +515,8 @@ def select_rs_mode(
     block: int = 256,
     rows: int = 5,
     cols: int = 0,
+    bins: int = 4096,
+    cap_headroom: float = 2.0,
     bw: Optional[float] = None,
     modes: Optional[tuple] = None,
     compute_time: float = 0.0,
@@ -514,13 +534,14 @@ def select_rs_mode(
     scales as 1/bw, so a bandwidth-only profile can never flip this argmin
     (that is a property of the model, not a bug; the hierarchical planner
     is where fitted encode/decode costs change picks)."""
-    candidates = modes or ("sparse", "adaptive", "quantized", "sketch")
+    candidates = modes or ("sparse", "adaptive", "quantized", "sketch", "oktopk")
     best, best_t = None, float("inf")
     for mode in candidates:
         t = rs_step_time(
             mode, d, W, ratio,
             headroom=headroom, out_headroom=out_headroom,
-            block=block, rows=rows, cols=cols, bw=bw,
+            block=block, rows=rows, cols=cols,
+            bins=bins, cap_headroom=cap_headroom, bw=bw,
             compute_time=compute_time, profile=profile,
         )
         if t < best_t:

@@ -5,7 +5,7 @@ README.md:37) makes every worker decode every peer's payload: O(W·k) decode
 work and W·k wire entries per worker. The sparse-allreduce literature
 (PAPERS.md: "Near-Optimal Sparse Allreduce" (Ok-Topk), SparCML, S2 Reducer)
 splits the universe into W contiguous shards instead, and this module now
-carries four routes over that skeleton, selected by ``rs_mode``:
+carries five routes over that skeleton, selected by ``rs_mode``:
 
 - ``sparse`` (default; byte-identical trace to the pre-r11 exchange):
     phase 1 (sparse reduce-scatter): each worker routes its top-k entries
@@ -36,6 +36,20 @@ carries four routes over that skeleton, selected by ``rs_mode``:
     only *its shard* (O(d·rows/W) — the decode itself is sharded) and
     re-enters the sparse phase 2. Error feedback uses the unsketch
     estimate of the worker's own sketch at the globally selected indices.
+- ``oktopk`` (the Ok-Topk balanced exchange proper): a psum'd magnitude
+    histogram over each worker's local top-k candidates picks ONE global
+    threshold targeting ~k total survivors (bit-pattern bucketing — for
+    positive f32 the int32 interpretation is monotonic in value, so
+    ``bitcast(|v|) >> shift`` is a shared magnitude quantizer needing no
+    scale agreement); only coordinates at-or-above the threshold are
+    routed to their shard-owners through the same stable-sort all_to_all,
+    but with a W×-smaller per-(worker, shard) capacity ``~k/W²·headroom``
+    since the *global* survivor count is ~k, not k per worker. Sub-
+    threshold mass AND capacity-spilled mass both stay in the sender's
+    residual (own-transmitted EF counts kept entries only). Owner-local
+    reduction and the sparse phase 2 are unchanged. Per-worker wire is
+    O(k/W) + the fixed histogram — O(k) total across the mesh, the
+    Ok-Topk headline.
 
 Per-worker wire ~ k·headroom + k entries vs the allgather path's W·k, and
 decode is O(k) instead of O(W·k) — the gap grows with the mesh. The phase-2
@@ -63,7 +77,7 @@ from deepreduce_tpu.codecs import countsketch
 from deepreduce_tpu.metrics import WireStats
 from deepreduce_tpu.telemetry import spans
 
-RS_EXCHANGE_MODES = ("sparse", "adaptive", "quantized", "sketch")
+RS_EXCHANGE_MODES = ("sparse", "adaptive", "quantized", "sketch", "oktopk")
 
 
 def shard_size(d: int, num_workers: int) -> int:
@@ -117,6 +131,29 @@ def quantized_levels_budget(num_workers: int) -> int:
     return max(1, 127 // num_workers)
 
 
+def oktopk_send_budget(
+    d: int, ratio: float, num_workers: int, cap_headroom: float = 2.0
+) -> int:
+    """Per-(worker, shard) slots in the oktopk all_to_all: the global
+    threshold targets ~k survivors TOTAL, so one worker holds ~k/W of them
+    and spreads those over W shards — expected occupancy k/W² per pair,
+    times headroom. Overflow (and the degenerate all-equal-magnitude case
+    where every candidate ties at the threshold bucket) spills into the
+    sender's residual."""
+    k = sparse.num_slots(d, ratio)
+    return max(1, int(math.ceil(k / (num_workers * num_workers) * cap_headroom)))
+
+
+def oktopk_shift(bins: int) -> int:
+    """Right-shift turning a positive-f32 bit pattern into a histogram
+    bucket in [0, bins): finite positive f32 patterns live in [0, 2^31),
+    so `31 - log2(bins)` maps them onto exactly `bins` buckets while
+    preserving magnitude order (bit-pattern order == value order for
+    non-negative floats). With the 4096-bin default each exponent octave
+    gets 16 sub-bins — ~4% relative threshold granularity."""
+    return 31 - int(round(math.log2(bins)))
+
+
 def exchange(
     flat: jax.Array,
     axis_name: str,
@@ -132,6 +169,8 @@ def exchange(
     sketch_rows: int = 5,
     sketch_cols: int = 0,
     sketch_seed: int = 0,
+    oktopk_bins: int = 4096,
+    oktopk_cap_headroom: float = 2.0,
     key: Optional[jax.Array] = None,
     collect: Optional[dict] = None,
 ) -> Tuple[jax.Array, jax.Array, WireStats]:
@@ -141,7 +180,8 @@ def exchange(
     `rs_mode` must be one of RS_EXCHANGE_MODES (``auto`` is resolved by the
     caller). `key` is required by the stochastic-rounding routes (adaptive,
     quantized). `collect`, when a dict, receives the adaptive route's
-    density/switch observables."""
+    density/switch observables and the oktopk route's survivor/threshold/
+    spill observables."""
     if rs_mode == "sparse":
         return _exchange_sparse(
             flat, axis_name, num_workers, ratio=ratio, approx_topk=approx_topk,
@@ -163,6 +203,22 @@ def exchange(
             flat, axis_name, num_workers, ratio=ratio,
             out_headroom=out_headroom, rows=sketch_rows, cols=sketch_cols,
             seed=sketch_seed,
+        )
+    if rs_mode == "oktopk":
+        if approx_topk:
+            # the threshold-count containment argument needs the local
+            # candidate set to be the EXACT top-k: an approximate selection
+            # can miss above-threshold entries, biasing the psum'd survivor
+            # count the threshold is solved against (config fences this as
+            # 'rs-oktopk-vs-approx-topk'; this is the traced-path backstop)
+            raise ValueError(
+                "rs_mode='oktopk' requires exact local top-k candidates "
+                "(approx_topk=False)"
+            )
+        return _exchange_oktopk(
+            flat, axis_name, num_workers, ratio=ratio,
+            out_headroom=out_headroom, bins=oktopk_bins,
+            cap_headroom=oktopk_cap_headroom, collect=collect,
         )
     raise ValueError(
         f"rs_mode={rs_mode!r} is not a concrete sparse_rs route "
@@ -575,3 +631,127 @@ def _exchange_sketch(
         dense_bits=jnp.asarray(d * 32.0, jnp.float32),
     )
     return mean.astype(flat.dtype), own_dense.astype(flat.dtype), stats
+
+
+def _exchange_oktopk(
+    flat, axis_name, num_workers, *, ratio, out_headroom, bins,
+    cap_headroom, collect,
+):
+    """Ok-Topk phase 1: one psum'd bit-pattern magnitude histogram over the
+    local exact top-k candidates picks a single global threshold targeting
+    ~k survivors TOTAL; survivors route to their shard-owners through the
+    stable-sort all_to_all with a W×-smaller per-pair capacity. Containment
+    argument: every global survivor is, at its own worker, one of at most k
+    entries at-or-above a threshold that globally admits ~k — so the local
+    exact top-k candidate set cannot miss it. Deterministic (no PRNG): the
+    only losses are sub-threshold mass and capacity spill, both of which
+    stay in the residual via the kept-entries-only own-transmitted EF."""
+    d = flat.shape[0]
+    W = num_workers
+    S = shard_size(d, W)
+    Bo = oktopk_send_budget(d, ratio, W, cap_headroom)
+    K2 = out_budget(d, ratio, W, out_headroom)
+    shift = oktopk_shift(bins)
+
+    # --- candidates: local exact top-k (descending |v| order) ----------- #
+    with spans.span("sparse_rs/select"):
+        sp = sparse.topk(flat, ratio, sort_indices=False, approx=False)
+    k = sp.k
+    live = jnp.arange(k, dtype=jnp.int32) < sp.nnz
+    mag = jnp.where(live, jnp.abs(sp.values), 0.0).astype(jnp.float32)
+
+    # --- global threshold from one psum'd histogram --------------------- #
+    # non-negative f32 bit patterns sort like the values, so the shifted
+    # pattern is a shared magnitude bucket — no scale agreement (no pmax)
+    bucket = jnp.right_shift(
+        jax.lax.bitcast_convert_type(mag, jnp.int32), shift
+    )
+    weight = jnp.logical_and(live, mag > 0.0).astype(jnp.float32)
+    hist = jnp.zeros((bins,), jnp.float32).at[bucket].add(weight)
+    # zero-weight dead slots land in bucket 0: adding 0 is exact
+    with spans.span("sparse_rs/psum"):
+        g_hist = jax.lax.psum(hist, axis_name)
+    # cum[j] = global count of candidates in bucket >= j; the threshold is
+    # the HIGHEST bucket still admitting >= k entries. All-false (fewer
+    # than k nonzero candidates in the whole mesh) degrades to bucket 0 —
+    # every nonzero entry survives, which is correct: total < k.
+    cum = jnp.flip(jnp.cumsum(jnp.flip(g_hist)))
+    ok = cum >= float(k)
+    b_star = jnp.max(
+        jnp.where(ok, jnp.arange(bins, dtype=jnp.int32), 0)
+    )
+    survive = jnp.logical_and(
+        jnp.logical_and(live, mag > 0.0), bucket >= b_star
+    )
+
+    # --- balanced routing: survivors only, capacity Bo per pair --------- #
+    shard_of = jnp.where(survive, sp.indices // S, W)  # dead -> parked W
+    # stable sort by shard keeps the descending-|v| candidate order within
+    # each shard, so capacity overflow drops the smallest magnitudes
+    order = jnp.argsort(shard_of, stable=True)
+    sh = shard_of[order]
+    vals = sp.values[order]
+    idxs = sp.indices[order]
+    pos = jnp.arange(k, dtype=jnp.int32)
+    first_of_run = jnp.where(
+        jnp.concatenate([jnp.ones((1,), bool), sh[1:] != sh[:-1]]), pos, -1
+    )
+    run_start = jax.lax.cummax(first_of_run)
+    rank = pos - run_start
+    keep = jnp.logical_and(sh < W, rank < Bo)
+    tgt = jnp.where(keep, sh * Bo + rank, W * Bo + pos)
+    send_v = (
+        jnp.zeros((W * Bo,), flat.dtype)
+        .at[tgt].set(vals, mode="drop", unique_indices=True)
+        .reshape(W, Bo)
+    )
+    send_i = (
+        jnp.zeros((W * Bo,), jnp.int32)
+        .at[tgt].set(idxs - sh * S, mode="drop", unique_indices=True)
+        .reshape(W, Bo)
+    )
+    send_buf = jnp.concatenate(
+        [send_v.astype(jnp.float32),
+         jax.lax.bitcast_convert_type(send_i, jnp.float32)], axis=1
+    )  # [W, 2*Bo]
+    with spans.span("sparse_rs/route"):
+        rx = jax.lax.all_to_all(
+            send_buf, axis_name, split_axis=0, concat_axis=0, tiled=True
+        )
+    rx_v = rx[:, :Bo]
+    rx_i = jax.lax.bitcast_convert_type(rx[:, Bo:], jnp.int32)
+    with spans.span("sparse_rs/reduce"):
+        shard_buf = jnp.zeros((S,), jnp.float32).at[rx_i.reshape(-1)].add(
+            rx_v.reshape(-1).astype(jnp.float32)
+        )
+
+    # --- phase 2: sparse re-select + allgather --------------------------- #
+    widx = jax.lax.axis_index(axis_name)
+    out_buf = _phase2_pack(shard_buf, widx, S, K2)
+    with spans.span("sparse_rs/allgather"):
+        gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
+    _, _, dense = _phase2_unpack(gathered, K2, W, S)
+    mean = dense[:d] / W
+
+    own_dense = _own_transmitted(flat, keep, idxs, vals, pos, W, S, d)
+
+    if collect is not None:
+        # survivors: the global count the threshold admitted (identical on
+        # every worker — the psum'd cumulative at b_star); spills: entries
+        # THIS worker's threshold passed but capacity dropped (per-worker)
+        collect["rs_oktopk_survivors"] = jnp.take(cum, b_star)
+        collect["rs_oktopk_threshold"] = jax.lax.bitcast_convert_type(
+            jnp.left_shift(b_star, shift), jnp.float32
+        )
+        collect["rs_oktopk_spills"] = jnp.sum(
+            survive.astype(jnp.float32)
+        ) - jnp.sum(keep.astype(jnp.float32))
+
+    # wire accounting: histogram lanes are value-side; every routed or
+    # gathered entry is an f32 value + i32 index
+    stats = WireStats(
+        index_bits=jnp.asarray((W * Bo + K2) * 32.0, jnp.float32),
+        value_bits=jnp.asarray((W * Bo + K2 + bins) * 32.0, jnp.float32),
+        dense_bits=jnp.asarray(d * 32.0, jnp.float32),
+    )
+    return mean.astype(flat.dtype), own_dense, stats

@@ -73,6 +73,13 @@ class MetricAccumulators:
     # divide both by `steps` on the host for the running rates
     rs_density: jax.Array
     rs_dense_switches: jax.Array
+    # oktopk route (sparse_rs rs_mode='oktopk'): Σ per-step psum'd global
+    # survivor count the threshold admitted, Σ per-step threshold value
+    # (the bit-pattern bucket floor, an f32 magnitude), and Σ per-step
+    # survivors this worker's capacity dropped into the residual
+    rs_oktopk_survivors: jax.Array
+    rs_oktopk_threshold: jax.Array
+    rs_oktopk_spills: jax.Array
     # hierarchical exchange: Σ per-step bits one device moved on the
     # intra-slice ICI fabric (slice-mean psum/qar leg + key repair). Stays
     # 0.0 in flat exchanges; the scarce-link (flat/DCN) volume remains in
@@ -108,6 +115,9 @@ class MetricAccumulators:
         checksum_failures=0.0,
         rs_density=0.0,
         rs_dense_switches=0.0,
+        rs_oktopk_survivors=0.0,
+        rs_oktopk_threshold=0.0,
+        rs_oktopk_spills=0.0,
         bucket_saturated=0.0,
     ) -> "MetricAccumulators":
         f = lambda x: jnp.asarray(x, jnp.float32)
@@ -127,6 +137,9 @@ class MetricAccumulators:
             checksum_failures=self.checksum_failures + f(checksum_failures),
             rs_density=self.rs_density + f(rs_density),
             rs_dense_switches=self.rs_dense_switches + f(rs_dense_switches),
+            rs_oktopk_survivors=self.rs_oktopk_survivors + f(rs_oktopk_survivors),
+            rs_oktopk_threshold=self.rs_oktopk_threshold + f(rs_oktopk_threshold),
+            rs_oktopk_spills=self.rs_oktopk_spills + f(rs_oktopk_spills),
             ici_bits=self.ici_bits + f(wire.ici_bits),
             # broadcasts: [C] + [C] per-step vector, or [C] + 0.0 when the
             # caller has nothing to report this step (and [0] + 0.0 when
@@ -200,6 +213,12 @@ class MetricAccumulators:
             # phase-1 reduce, and the dense-row switch rate
             "rs_density_per_step": vals["rs_density"] / steps,
             "rs_dense_switch_rate": vals["rs_dense_switches"] / steps,
+            # oktopk sparse_rs: mean global survivor count the psum'd
+            # threshold admitted, mean threshold magnitude, and mean
+            # capacity-spilled survivors per worker per step
+            "rs_oktopk_survivors_per_step": vals["rs_oktopk_survivors"] / steps,
+            "rs_oktopk_threshold": vals["rs_oktopk_threshold"] / steps,
+            "rs_oktopk_spill_rate": vals["rs_oktopk_spills"] / steps,
             # hierarchical exchange: per-step per-device bytes on each
             # fabric (dcn = the scarce-link index+value volume above)
             "ici_bytes_per_step": vals["ici_bits"] / 8.0 / steps,

@@ -234,6 +234,19 @@ def make_worker_step(
             rs_dense_switches=jax.lax.pmean(collect["rs_dense_switches"], axis)
             if "rs_dense_switches" in collect
             else 0.0,
+            # oktopk sparse_rs: survivor count and threshold are psum'd
+            # inside the route (identical on every worker — pmean is the
+            # identity aggregate); spills are per-worker, pmean'd to the
+            # mean spilled survivors per worker
+            rs_oktopk_survivors=jax.lax.pmean(collect["rs_oktopk_survivors"], axis)
+            if "rs_oktopk_survivors" in collect
+            else 0.0,
+            rs_oktopk_threshold=jax.lax.pmean(collect["rs_oktopk_threshold"], axis)
+            if "rs_oktopk_threshold" in collect
+            else 0.0,
+            rs_oktopk_spills=jax.lax.pmean(collect["rs_oktopk_spills"], axis)
+            if "rs_oktopk_spills" in collect
+            else 0.0,
             bucket_saturated=(
                 jax.lax.psum(bucket_sat, axis) if bucket_sat is not None else 0.0
             ),

@@ -1,0 +1,292 @@
+"""Ok-Topk balanced in-collective route (rs_mode='oktopk', r18): psum'd
+bit-pattern histogram threshold, capacity-capped balanced all_to_all,
+transmitted-mass oracle exactness, capacity-spill EF containment, config
+fences, cost-model mirror, selector regime split, telemetry rows."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import shared_mesh
+from deepreduce_tpu.utils.compat import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepreduce_tpu import costmodel, sparse, sparse_rs
+from deepreduce_tpu.config import DeepReduceConfig
+
+W = 8
+LSTM_D = 4_053_428  # the paper's StackOverflow LSTM gradient length
+
+
+def _run(flat_w, ratio, *, workers=W, out_headroom=1.0, bins=4096,
+         cap_headroom=2.0, with_collect=False):
+    """[workers, d] per-worker gradients -> (mean, own[, collect rows])."""
+
+    def spmd(g):
+        collect = {} if with_collect else None
+        mean, own, stats = sparse_rs.exchange(
+            g[0], "data", workers, ratio=ratio, rs_mode="oktopk",
+            out_headroom=out_headroom, oktopk_bins=bins,
+            oktopk_cap_headroom=cap_headroom, collect=collect,
+        )
+        if with_collect:
+            return (mean[None], own[None],
+                    collect["rs_oktopk_survivors"][None],
+                    collect["rs_oktopk_threshold"][None],
+                    collect["rs_oktopk_spills"][None])
+        return mean[None], own[None]
+
+    n_out = 5 if with_collect else 2
+    fn = jax.jit(
+        shard_map(
+            spmd, mesh=shared_mesh(workers), in_specs=(P("data"),),
+            out_specs=tuple(P("data") for _ in range(n_out)),
+            check_vma=False,
+        )
+    )
+    return fn(flat_w)
+
+
+def _assert_transmitted_oracle(flat_w, mean, own, workers):
+    """The route's exactness contract: the aggregate is the mean of the
+    TRANSMITTED (own) masses — never of the full gradients; Ok-Topk keeps
+    sub-threshold and capacity-spilled mass in the sender's residual. And
+    own itself is a bitwise subset of the worker's gradient."""
+    mean = np.asarray(mean)
+    own = np.asarray(own)
+    assert np.allclose(mean, mean[0][None])  # workers agree
+    want = own.astype(np.float64).sum(axis=0) / workers
+    np.testing.assert_allclose(mean[0], want, rtol=1e-6, atol=1e-7)
+    for w in range(workers):
+        nz = np.nonzero(own[w])[0]
+        np.testing.assert_array_equal(own[w][nz], flat_w[w][nz])
+
+
+def test_mean_equals_transmitted_oracle():
+    """Random gradients, ample phase-2 budget: the mean must equal the
+    sum-of-own-transmitted oracle (no coordinate is invented or dropped
+    after routing), with every own entry bitwise from the sender."""
+    rng = np.random.default_rng(20)
+    d, ratio = 4096, 0.02
+    flat_w = rng.normal(size=(W, d)).astype(np.float32)
+    mean, own = _run(jnp.asarray(flat_w), ratio, out_headroom=2.0 * W)
+    _assert_transmitted_oracle(flat_w, mean, own, W)
+
+
+def test_w2_mesh_exact():
+    """The smallest real mesh (W=2): balanced routing with one peer."""
+    rng = np.random.default_rng(21)
+    W2, d, ratio = 2, 4096, 0.02
+    flat_w = rng.normal(size=(W2, d)).astype(np.float32)
+    mean, own = _run(
+        jnp.asarray(flat_w), ratio, workers=W2, out_headroom=2.0 * W2
+    )
+    _assert_transmitted_oracle(flat_w, mean, own, W2)
+
+
+def test_unaligned_d_padded_tail():
+    """d not divisible by W: the short last shard must stay exact — local
+    indices route relative to their shard and the [:d] slice drops the
+    padding."""
+    rng = np.random.default_rng(22)
+    d, ratio = 4090, 0.02  # W*S = 4096 > d
+    assert d % W != 0
+    flat_w = rng.normal(size=(W, d)).astype(np.float32)
+    mean, own = _run(jnp.asarray(flat_w), ratio, out_headroom=2.0 * W)
+    _assert_transmitted_oracle(flat_w, mean, own, W)
+
+
+def test_all_equal_magnitudes_deterministic():
+    """Degenerate histogram: every candidate ties in ONE bucket, so the
+    threshold admits them all and capacity does the triage. The route has
+    no PRNG — two runs must agree bitwise — and the collect observables
+    must report the tie storm: survivors == W*k (identical workers),
+    per-worker spills == survivors/W - kept."""
+    d, ratio = 4096, 0.02
+    k = sparse.num_slots(d, ratio)
+    g = np.zeros(d, np.float32)
+    g[:k] = 2.5  # all-equal magnitudes, all in shard 0
+    flat_w = np.tile(g, (W, 1))
+    out1 = _run(jnp.asarray(flat_w), ratio, with_collect=True)
+    out2 = _run(jnp.asarray(flat_w), ratio, with_collect=True)
+    for a, b in zip(out1, out2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mean, own, survivors, threshold, spills = out1
+    _assert_transmitted_oracle(flat_w, mean, own, W)
+    assert np.all(np.asarray(survivors) == float(W * k))
+    assert np.all(np.asarray(threshold) > 0.0)
+    Bo = sparse_rs.oktopk_send_budget(d, ratio, W)
+    kept = np.count_nonzero(np.asarray(own)[0])
+    assert kept <= Bo  # every candidate lives in shard 0: one pair's cap
+    assert np.all(np.asarray(spills) == float(k - kept))
+
+
+def test_zero_gradient_zero_survivors():
+    """All-zero gradients: the mag>0 guard keeps zeros out of the
+    histogram, so nothing survives, nothing routes, and every observable
+    reads zero — no NaNs from the empty threshold."""
+    flat_w = np.zeros((W, 4096), np.float32)
+    mean, own, survivors, threshold, spills = _run(
+        jnp.asarray(flat_w), 0.02, with_collect=True
+    )
+    assert np.all(np.asarray(mean) == 0.0)
+    assert np.all(np.asarray(own) == 0.0)
+    assert np.all(np.asarray(survivors) == 0.0)
+    assert np.all(np.asarray(spills) == 0.0)
+    assert np.all(np.asarray(threshold) == 0.0)
+
+
+def test_capacity_spill_lands_in_residual_bitwise():
+    """Adversarial crowding: k distinct magnitudes all in shard 0. The
+    per-pair capacity keeps only the largest Bo survivors; the residual
+    (gradient minus own-transmitted) must hold every spilled entry at its
+    exact bitwise value and zero at every kept position."""
+    d, ratio = 4096, 0.05  # k=204
+    k = sparse.num_slots(d, ratio)
+    g = np.zeros(d, np.float32)
+    g[:k] = np.arange(1, k + 1, dtype=np.float32)  # largest at highest idx
+    flat_w = np.tile(g, (W, 1))
+    mean, own = _run(jnp.asarray(flat_w), ratio, out_headroom=2.0 * W)
+    own0 = np.asarray(own)[0]
+    sent = np.nonzero(own0)[0]
+    Bo = sparse_rs.oktopk_send_budget(d, ratio, W)
+    assert 0 < len(sent) <= Bo  # capacity engaged (survivors >> Bo)
+    # stable routing keeps descending-|v| order: kept == largest magnitudes
+    np.testing.assert_array_equal(sent, np.arange(k - len(sent), k))
+    residual = g - own0
+    np.testing.assert_array_equal(residual[sent], np.zeros(len(sent)))
+    spilled = np.setdiff1d(np.arange(k), sent)
+    np.testing.assert_array_equal(residual[spilled], g[spilled])
+    _assert_transmitted_oracle(flat_w, mean, own, W)
+
+
+def test_dispatcher_rejects_approx_candidates():
+    """The threshold-containment argument needs the EXACT local top-k
+    candidate set; approximate candidates can miss global survivors. The
+    traced-path backstop mirrors the config fence."""
+    flat = jnp.zeros((4096,), jnp.float32)
+    with pytest.raises(ValueError, match="approx_topk"):
+        sparse_rs.exchange(
+            flat, "data", W, ratio=0.02, rs_mode="oktopk", approx_topk=True
+        )
+
+
+def _cfg(**kw):
+    return DeepReduceConfig(
+        compressor="topk", compress_ratio=0.03, memory="none",
+        communicator="sparse_rs", deepreduce=None, **kw,
+    )
+
+
+def test_config_validates_oktopk_knobs():
+    cfg = _cfg(rs_mode="oktopk", rs_oktopk_bins=1024, rs_oktopk_cap_headroom=1.5)
+    assert cfg.rs_oktopk_bins == 1024
+    for bad_bins in (0, 32, 1000, 1 << 25):
+        with pytest.raises(ValueError, match="rs-oktopk-bins-range"):
+            _cfg(rs_oktopk_bins=bad_bins)
+    with pytest.raises(ValueError, match="rs-oktopk-cap-headroom-range"):
+        _cfg(rs_oktopk_cap_headroom=0.0)
+    with pytest.raises(ValueError, match="rs-oktopk-vs-approx-topk"):
+        _cfg(rs_mode="oktopk", approx_topk=True)
+    # the fence is oktopk-specific: approx candidates stay fine elsewhere
+    assert _cfg(rs_mode="sparse", approx_topk=True).approx_topk
+
+
+def test_costmodel_wire_dict_mirrors_route():
+    """The per-collective byte dict the jx-wire-accounting rule pins must
+    be exactly the route's static shapes: bins f32 lanes psum'd, W*Bo
+    (value, index) pairs through the all_to_all, K2 pairs gathered."""
+    for d, ratio, Wm in ((4096, 0.02, 8), (8192, 0.05, 16), (4090, 0.01, 2)):
+        wire = costmodel.rs_wire_bytes("oktopk", d, Wm, ratio)
+        Bo = sparse_rs.oktopk_send_budget(d, ratio, Wm)
+        K2 = sparse_rs.out_budget(d, ratio, Wm)
+        assert wire == {
+            "psum": 4096 * 4.0,
+            "all_to_all": Wm * Bo * 8.0,
+            "all_gather": K2 * 8.0,
+        }
+        assert costmodel.rs_payload_bytes("oktopk", d, Wm, ratio) == sum(
+            wire.values()
+        )
+
+
+def test_selector_regime_split():
+    """The acceptance regime: at the LSTM gradient length the O(k) route
+    dominates the whole sparse grid — including ratio <= 0.01 — while the
+    small-d picks that seeded the committed lattice/calibration artifacts
+    are untouched (argmin over 5 == argmin over the old 4)."""
+    old = ("sparse", "adaptive", "quantized", "sketch")
+    for ratio in (0.001, 0.01, 0.1):
+        for Wm in (8, 16, 32):
+            assert costmodel.select_rs_mode(LSTM_D, Wm, ratio) == "oktopk"
+            t_ok = costmodel.rs_step_time("oktopk", LSTM_D, Wm, ratio)
+            t_q = costmodel.rs_step_time("quantized", LSTM_D, Wm, ratio)
+            if ratio <= 0.01:
+                assert t_ok < t_q
+    for d in (4096, 8192):
+        for ratio in (0.001, 0.01, 0.02, 0.1):
+            for Wm in (8, 16, 32):
+                assert costmodel.select_rs_mode(d, Wm, ratio) == \
+                    costmodel.select_rs_mode(d, Wm, ratio, modes=old)
+
+
+def test_telemetry_accumulates_and_derives_oktopk_rows():
+    from deepreduce_tpu.metrics import WireStats
+    from deepreduce_tpu.telemetry.device_metrics import MetricAccumulators
+
+    acc = MetricAccumulators.zeros()
+    wire = WireStats(
+        index_bits=jnp.asarray(32.0), value_bits=jnp.asarray(64.0),
+        dense_bits=jnp.asarray(4096.0),
+    )
+    acc = acc.accumulate(
+        wire, rs_oktopk_survivors=150.0, rs_oktopk_threshold=3.0,
+        rs_oktopk_spills=4.0,
+    )
+    acc = acc.accumulate(
+        wire, rs_oktopk_survivors=130.0, rs_oktopk_threshold=5.0,
+        rs_oktopk_spills=0.0,
+    )
+    rows = acc.summary()
+    assert rows["rs_oktopk_survivors_per_step"] == pytest.approx(140.0)
+    assert rows["rs_oktopk_threshold"] == pytest.approx(4.0)
+    assert rows["rs_oktopk_spill_rate"] == pytest.approx(2.0)
+
+
+def test_trainer_path_oktopk_ef_residual():
+    """Full GradientExchanger round: finite aggregate, wire volume far
+    under dense (O(k) route), residual retains the untransmitted mass."""
+    from deepreduce_tpu.comm import GradientExchanger
+
+    rng = np.random.default_rng(23)
+    d = 8192
+    cfg = DeepReduceConfig(
+        compressor="topk", compress_ratio=0.03, memory="residual",
+        communicator="sparse_rs", deepreduce=None, rs_mode="oktopk",
+    )
+    grads = {"g": jnp.asarray(rng.normal(size=d).astype(np.float32))}
+    ex = GradientExchanger(grads, cfg, axis_name="data", num_workers=W)
+    state = ex.init_state(grads)
+
+    def spmd(g, res):
+        agg, new_res, stats = ex.exchange(
+            g, res, step=jnp.zeros((), jnp.int32), key=jax.random.PRNGKey(0)
+        )
+        return agg, new_res, stats
+
+    fn = jax.jit(
+        shard_map(
+            spmd, mesh=shared_mesh(W), in_specs=(P(), P()),
+            out_specs=(P(), P(), P()), check_vma=False,
+        )
+    )
+    agg, new_state, stats = fn(grads, state)
+    assert np.isfinite(np.asarray(agg["g"])).all()
+    vol = float(stats.rel_volume())
+    assert 0 < vol < 1.0
+    res = np.asarray(jax.tree_util.tree_leaves(new_state)[0])
+    assert np.abs(res).sum() > 0
+    assert ex.payload_bytes(grads) == costmodel.rs_payload_bytes(
+        "oktopk", d, W, cfg.compress_ratio,
+        bins=cfg.rs_oktopk_bins, cap_headroom=cfg.rs_oktopk_cap_headroom,
+    )

@@ -411,7 +411,7 @@ def _rs_cfg(**kw):
 
 
 def test_config_validates_rs_fields():
-    for mode in ("adaptive", "quantized", "sketch", "auto"):
+    for mode in ("adaptive", "quantized", "sketch", "oktopk", "auto"):
         assert _rs_cfg(rs_mode=mode).rs_mode == mode
     with pytest.raises(ValueError, match="rs_mode"):
         _rs_cfg(rs_mode="bogus")
@@ -452,7 +452,8 @@ def test_auto_mode_resolves_via_costmodel():
         d, W, cfg.compress_ratio,
         headroom=cfg.rs_headroom, out_headroom=cfg.rs_out_headroom,
         block=cfg.rs_block_size, rows=cfg.rs_sketch_rows,
-        cols=cfg.rs_sketch_cols,
+        cols=cfg.rs_sketch_cols, bins=cfg.rs_oktopk_bins,
+        cap_headroom=cfg.rs_oktopk_cap_headroom,
     )
     assert ex._rs_mode == want
     assert ex._rs_mode in sparse_rs.RS_EXCHANGE_MODES
@@ -473,7 +474,8 @@ def test_payload_bytes_matches_costmodel_per_mode():
             mode, d, W, cfg.compress_ratio,
             headroom=cfg.rs_headroom, out_headroom=cfg.rs_out_headroom,
             block=cfg.rs_block_size, rows=cfg.rs_sketch_rows,
-            cols=cfg.rs_sketch_cols,
+            cols=cfg.rs_sketch_cols, bins=cfg.rs_oktopk_bins,
+            cap_headroom=cfg.rs_oktopk_cap_headroom,
         )
         assert ex.payload_bytes(grads) == want
         assert 0 < want < 4 * d * 2

@@ -85,19 +85,56 @@ overlap-check:
 # dropped) step time within tolerance and the profile record passes
 # schema validation. A second fit must be byte-identical — the fit reads
 # only recorded telemetry, never the wall clock.
+#
+# The hierarchical arm re-runs the same model on the (2,4) two-axis mesh
+# (ici_size=4, hier_ici='qar'): its exchange/ici spans carry real ICI
+# seconds, so the fit must move bw_ici from the static constants into the
+# fitted set (--require-fitted bw_ici), and the v2 profile must carry
+# per-route rows for both the 'fused' DCN leg and the 'qar' ICI codec.
+# The cross-profile drift sentinel then gates both ways: the two bitwise-
+# identical hier fits must not flip any committed bench plan selection
+# (exit 0), while the TRACE_OVERLAP_r15 golden fit vs the static
+# constants is a planted drift that MUST flip a BENCH_CALIB_r16 pick
+# (exit 1) — proving the gate actually fires.
 CALIB_CHECK_DIR := /tmp/drtpu_calib_check
+CALIB_CHECK_CFG := 'compressor':'topk','compress_ratio':0.05,'deepreduce':'index','index':'bloom','fpr':0.01,'memory':'residual'
 calibrate-check:
 	rm -rf $(CALIB_CHECK_DIR)
 	JAX_PLATFORMS=cpu python benchmarks/train.py --platform cpu \
 		--model mlp --num_steps 8 --batch_size 8 --num_workers 8 --seed 0 \
 		--telemetry --track_dir $(CALIB_CHECK_DIR) --run_name calib \
 		--log_every 0 \
-		--grace_config "{'compressor':'topk','compress_ratio':0.05,'deepreduce':'index','index':'bloom','fpr':0.01,'memory':'residual'}"
+		--grace_config "{$(CALIB_CHECK_CFG)}"
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry calibrate \
 		$(CALIB_CHECK_DIR)/calib --out $(CALIB_CHECK_DIR)/profile.json
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry calibrate \
 		$(CALIB_CHECK_DIR)/calib --out $(CALIB_CHECK_DIR)/profile2.json
 	cmp $(CALIB_CHECK_DIR)/profile.json $(CALIB_CHECK_DIR)/profile2.json
+	JAX_PLATFORMS=cpu python benchmarks/train.py --platform cpu \
+		--model mlp --num_steps 8 --batch_size 8 --num_workers 8 --seed 0 \
+		--telemetry --track_dir $(CALIB_CHECK_DIR) --run_name hier \
+		--log_every 0 \
+		--grace_config "{$(CALIB_CHECK_CFG),'hier':True,'hier_ici':'qar','ici_size':4}"
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry calibrate \
+		$(CALIB_CHECK_DIR)/hier --out $(CALIB_CHECK_DIR)/hier_profile.json \
+		--require-fitted bw_ici
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry calibrate \
+		$(CALIB_CHECK_DIR)/hier --out $(CALIB_CHECK_DIR)/hier_profile2.json \
+		--require-fitted bw_ici
+	cmp $(CALIB_CHECK_DIR)/hier_profile.json $(CALIB_CHECK_DIR)/hier_profile2.json
+	python -c "import json; rec=json.load(open('$(CALIB_CHECK_DIR)/hier_profile.json')); \
+		assert len(rec['routes']) >= 2, rec['routes']"
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry profiles \
+		$(CALIB_CHECK_DIR)/hier_profile.json $(CALIB_CHECK_DIR)/hier_profile2.json \
+		--against BENCH_HIER_r12.json --against BENCH_CALIB_r16.json \
+		--against BENCH_OKTOPK_r18.json
+	JAX_PLATFORMS=cpu python -c "from deepreduce_tpu import costmodel; \
+		costmodel.static_profile().save('$(CALIB_CHECK_DIR)/static_profile.json')"
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry calibrate \
+		TRACE_OVERLAP_r15 --out $(CALIB_CHECK_DIR)/golden_profile.json
+	! JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry profiles \
+		$(CALIB_CHECK_DIR)/golden_profile.json $(CALIB_CHECK_DIR)/static_profile.json \
+		--against BENCH_CALIB_r16.json
 
 # end-to-end telemetry round trip on the CPU virtual mesh: a short
 # telemetry-on training run writes a tracked run dir (metrics + device

@@ -205,13 +205,19 @@ def _costmodel():
     return costmodel
 
 
-def _provenance(modeled, measured) -> dict:
+def _provenance(modeled, measured, profile=None) -> dict:
     """Honesty stamp on every committed record: which detail fields are
     cost-model arithmetic and which came off a clock. A reader (or the
     `telemetry compare --profile` re-pricer) must be able to tell a modeled
     claim — re-derivable from static constants or a fitted profile — from a
-    measurement that only a re-run can reproduce."""
-    return {"modeled": sorted(modeled), "measured": sorted(measured)}
+    measurement that only a re-run can reproduce. When the record's modeled
+    numbers came from a fitted MachineProfile, `profile_sha256` pins WHICH
+    profile (its content hash) so `telemetry profiles` drift reports can be
+    matched back to the exact fit that priced the claim."""
+    out = {"modeled": sorted(modeled), "measured": sorted(measured)}
+    if profile is not None:
+        out["profile_sha256"] = profile.content_hash()
+    return out
 
 
 def _latest_midround_record() -> str:
@@ -1421,6 +1427,7 @@ def calib_sweep(quick: bool = False, run: str = "TRACE_OVERLAP_r15") -> dict:
                 "points.*.calibrated_pick_fitted_s",
             ],
             measured=["profile"],
+            profile=prof,
         ),
         "detail": {
             "run": run,

@@ -556,7 +556,7 @@ class GradientExchanger:
             codecs = self.codecs
             payloads = {}
             stats_per = {}
-            with spans.span("exchange/encode"):
+            with spans.span("exchange/encode", route="fused"):
                 for name in self.names:
                     payloads[name] = self.codecs[name].encode(
                         flat_grads[name], step=step, key=keys[name]
@@ -717,7 +717,7 @@ class GradientExchanger:
           (comm_ring.ring_decode_exchange).
         """
         strategy = self.cfg.decode_strategy
-        with spans.span("exchange/pack"):
+        with spans.span("exchange/pack", route="fused"):
             buf = self._pack_fused(payloads)
 
         if self._chaos is not None:
@@ -739,14 +739,14 @@ class GradientExchanger:
                 row_weights=row_weights,
             )
         else:
-            with spans.span("exchange/allgather"):
+            with spans.span("exchange/allgather", route="fused"):
                 gathered = jax.lax.all_gather(buf, self.axis_name)  # [W, B]
             decoder = (
                 self._decode_gathered_vmap
                 if strategy == "vmap"
                 else self._decode_gathered_loop
             )
-            with spans.span("exchange/decode"):
+            with spans.span("exchange/decode", route="fused"):
                 total, own_fin = decoder(
                     gathered,
                     num_workers,
@@ -843,8 +843,10 @@ class GradientExchanger:
         else:
             key = None
         # encode/decode sub-spans make t_enc/t_dec separately identifiable
-        # to costmodel.calibrate; the wire work stays under exchange/sparse_rs
-        with spans.span("exchange/encode"):
+        # to costmodel.calibrate; the wire work stays under exchange/sparse_rs.
+        # The resolved route name attributes them to the active rs codec so
+        # the fit can emit a per-route row.
+        with spans.span("exchange/encode", route=rs_mode):
             compensated = grads
             if state is not None:
                 compensated = memory.compensate(
@@ -871,7 +873,7 @@ class GradientExchanger:
                 key=key,
                 collect=collect,
             )
-        with spans.span("exchange/decode"):
+        with spans.span("exchange/decode", route=rs_mode):
             agg = unravel(mean.astype(flat.dtype))
             new_state = state
             if state is not None:
@@ -894,7 +896,7 @@ class GradientExchanger:
             )
         from jax.flatten_util import ravel_pytree
 
-        with spans.span("exchange/encode"):
+        with spans.span("exchange/encode", route="qar"):
             flat, unravel = ravel_pytree(grads)
             d = flat.shape[0]
             n = qar.pad_len(d, self.num_workers, cfg.bucket_size)
@@ -917,7 +919,7 @@ class GradientExchanger:
                 bucket_size=cfg.bucket_size,
                 use_pallas=cfg.use_pallas,
             )[:d]
-        with spans.span("exchange/decode"):
+        with spans.span("exchange/decode", route="qar"):
             agg = unravel(mean.astype(flat.dtype))
         # one payload (int8 levels + f32 norms) per phase-equivalent dense
         # transmission: rel_volume = payload_bits / dense_bits, the same

@@ -378,7 +378,7 @@ class BucketedExchanger:
         """
         payloads: Dict[str, object] = {}
         stats_per: Dict[str, WireStats] = {}
-        with spans.span("exchange/encode"):
+        with spans.span("exchange/encode", route="bucketed"):
             for spec in self.specs:
                 codec = self.codecs[spec.label]
                 key = per_tensor_key(worker_key, spec.label, step)
@@ -387,7 +387,7 @@ class BucketedExchanger:
                 )
                 payloads[spec.label] = payload
                 stats_per[spec.label] = codec.wire_stats(payload)
-        with spans.span("exchange/pack"):
+        with spans.span("exchange/pack", route="bucketed"):
             bufs = [self.layouts[s.label].pack(payloads[s.label]) for s in self.specs]
 
         if self._chaos is not None:
@@ -407,29 +407,30 @@ class BucketedExchanger:
 
         def decode_into(b, gathered):
             with spans.span(f"exchange/bucket/{self.specs[b].label}"):
-                totals[b], owns[b], fails_per[b] = self._decode_bucket(
-                    self.specs[b],
-                    gathered,
-                    num_workers,
-                    step,
-                    need_own=need_own,
-                    row_weights=row_weights,
-                )
+                with spans.span("exchange/decode", route="bucketed"):
+                    totals[b], owns[b], fails_per[b] = self._decode_bucket(
+                        self.specs[b],
+                        gathered,
+                        num_workers,
+                        step,
+                        need_own=need_own,
+                        row_weights=row_weights,
+                    )
 
         if self.cfg.bucket_pipeline and C > 0:
             # Software pipeline in trace order (the comm_ring idiom): the
             # all_gather for bucket b+1 is dispatched BEFORE bucket b's
             # decode, so the next transfer overlaps the current decode.
-            with spans.span("exchange/allgather"):
+            with spans.span("exchange/allgather", route="bucketed"):
                 nxt = jax.lax.all_gather(bufs[0], self.axis_name)
             for b in range(C):
                 cur = nxt
                 if b + 1 < C:
-                    with spans.span("exchange/allgather"):
+                    with spans.span("exchange/allgather", route="bucketed"):
                         nxt = jax.lax.all_gather(bufs[b + 1], self.axis_name)
                 decode_into(b, cur)
         else:
-            with spans.span("exchange/allgather"):
+            with spans.span("exchange/allgather", route="bucketed"):
                 gathered = [jax.lax.all_gather(buf, self.axis_name) for buf in bufs]
             for b in range(C):
                 decode_into(b, gathered[b])
@@ -485,18 +486,19 @@ class BucketedExchanger:
         with spans.span(f"exchange/bucket/{spec.label}"):
             dense = self.concat_bucket(flat_grads, spec)
             dense, token = jax.lax.optimization_barrier((dense, token))
-            with spans.span("exchange/encode"):
+            with spans.span("exchange/encode", route="bucketed"):
                 key = per_tensor_key(worker_key, spec.label, step)
                 payload = codec.encode(dense, step=step, key=key)
                 stats = codec.wire_stats(payload)
-            with spans.span("exchange/pack"):
+            with spans.span("exchange/pack", route="bucketed"):
                 buf = self.layouts[spec.label].pack(payload)
-            with spans.span("exchange/allgather"):
+            with spans.span("exchange/allgather", route="bucketed"):
                 gathered = jax.lax.all_gather(buf, self.axis_name)
             gathered, token = jax.lax.optimization_barrier((gathered, token))
-            total, own, _fails = self._decode_bucket(
-                spec, gathered, num_workers, step, need_own=need_own
-            )
+            with spans.span("exchange/decode", route="bucketed"):
+                total, own, _fails = self._decode_bucket(
+                    spec, gathered, num_workers, step, need_own=need_own
+                )
         return total, own, stats, payload, token
 
     def saturation_vector(self, stats_per: Dict[str, WireStats]) -> jax.Array:

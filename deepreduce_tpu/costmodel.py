@@ -54,12 +54,28 @@ RS_MODES = ("sparse", "adaptive", "quantized", "sketch", "oktopk", "auto")
 # bitwise-replayable from a committed run dir.
 # ---------------------------------------------------------------------------
 
-PROFILE_SCHEMA = "deepreduce_tpu/machine-profile/v1"
+PROFILE_SCHEMA_V1 = "deepreduce_tpu/machine-profile/v1"
+PROFILE_SCHEMA = "deepreduce_tpu/machine-profile/v2"
 
 # the model parameters a profile carries; each is either "fitted" (recovered
 # from telemetry) or "fixed" (unidentifiable in that run — held at the
 # static constant and recorded as such)
 PROFILE_PARAMS = ("bw_dcn", "bw_ici", "t_enc", "t_dec", "compute_time")
+
+# keys every per-route row carries (v2 `routes` table): one encode / one
+# decode in seconds plus the number of labeled span events the fit saw
+ROUTE_ROW_KEYS = ("t_enc_s", "t_dec_s", "samples")
+
+# routes whose decode runs once per received payload — the fused
+# gather-then-decode family pays W decodes per step, so their fitted rows
+# divide the per-step decode seconds by W (matching the t_decode_s
+# convention measurement rows use). Every other route (the in-collective
+# rs family, qar) decodes once per step.
+GATHER_DECODE_ROUTES = frozenset({"fused", "bucketed"})
+
+
+def _route_decodes_per_step(label: str, W: int) -> int:
+    return W if label in GATHER_DECODE_ROUTES else 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,6 +97,7 @@ class MachineProfile:
     compute_time_s: float = 0.0
     fitted: Tuple[str, ...] = ()
     fixed: Tuple[str, ...] = PROFILE_PARAMS
+    routes: Dict[str, Dict[str, float]] = dataclasses.field(default_factory=dict)
     source: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_record(self) -> Dict[str, Any]:
@@ -93,12 +110,30 @@ class MachineProfile:
             "compute_time_s": float(self.compute_time_s),
             "fitted": list(self.fitted),
             "fixed": list(self.fixed),
+            "routes": {
+                label: {
+                    "t_enc_s": float(row["t_enc_s"]),
+                    "t_dec_s": float(row["t_dec_s"]),
+                    "samples": int(row["samples"]),
+                }
+                for label, row in sorted(self.routes.items())
+            },
             "source": dict(self.source),
         }
 
     @classmethod
     def from_record(cls, rec: Dict[str, Any]) -> "MachineProfile":
         validate_profile(rec)
+        # v1 records carry no route table: they load with routes == {} and
+        # every estimator/selector output stays byte-identical to r16.
+        routes = {
+            label: {
+                "t_enc_s": float(row["t_enc_s"]),
+                "t_dec_s": float(row["t_dec_s"]),
+                "samples": int(row["samples"]),
+            }
+            for label, row in (rec.get("routes") or {}).items()
+        }
         return cls(
             bw_dcn=float(rec["bw_dcn_bytes_per_s"]),
             bw_ici=float(rec["bw_ici_bytes_per_s"]),
@@ -107,8 +142,15 @@ class MachineProfile:
             compute_time_s=float(rec["compute_time_s"]),
             fitted=tuple(rec["fitted"]),
             fixed=tuple(rec["fixed"]),
+            routes=routes,
             source=dict(rec.get("source", {})),
         )
+
+    def content_hash(self) -> str:
+        """Deterministic digest of the full record — the provenance stamp
+        bench.py attaches to every record priced under this profile."""
+        blob = json.dumps(self.to_record(), sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
 
     def save(self, path) -> None:
         with open(path, "w") as f:
@@ -128,10 +170,14 @@ def validate_profile(rec: Any) -> None:
     accepts)."""
     if not isinstance(rec, dict):
         raise ValueError(f"profile record must be a dict, got {type(rec).__name__}")
-    if rec.get("schema") != PROFILE_SCHEMA:
+    schema = rec.get("schema")
+    if schema not in (PROFILE_SCHEMA, PROFILE_SCHEMA_V1):
         raise ValueError(
-            f"profile schema must be {PROFILE_SCHEMA!r}, got {rec.get('schema')!r}"
+            f"profile schema must be {PROFILE_SCHEMA!r} (or legacy "
+            f"{PROFILE_SCHEMA_V1!r}), got {schema!r}"
         )
+    if schema == PROFILE_SCHEMA_V1 and "routes" in rec:
+        raise ValueError("v1 profile records carry no 'routes' table")
     for key, positive in (
         ("bw_dcn_bytes_per_s", True),
         ("bw_ici_bytes_per_s", True),
@@ -164,6 +210,42 @@ def validate_profile(rec: Any) -> None:
         )
     if "source" in rec and not isinstance(rec["source"], dict):
         raise ValueError("profile field 'source' must be a dict")
+    routes = rec.get("routes")
+    if schema == PROFILE_SCHEMA and routes is not None:
+        if not isinstance(routes, dict):
+            raise ValueError(
+                f"profile field 'routes' must be a dict, got {type(routes).__name__}"
+            )
+        for label, row in routes.items():
+            if not isinstance(label, str) or not label:
+                raise ValueError(f"route label must be a non-empty string, got {label!r}")
+            if not isinstance(row, dict):
+                raise ValueError(
+                    f"route row {label!r} must be a dict, got {type(row).__name__}"
+                )
+            extra = set(row) - set(ROUTE_ROW_KEYS)
+            if extra:
+                raise ValueError(
+                    f"route row {label!r} has unknown keys {sorted(extra)} "
+                    f"(expected exactly {list(ROUTE_ROW_KEYS)})"
+                )
+            for key in ("t_enc_s", "t_dec_s"):
+                v = row.get(key)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    raise ValueError(
+                        f"route row {label!r} field {key!r} must be a number, got {v!r}"
+                    )
+                if not math.isfinite(float(v)) or float(v) < 0:
+                    raise ValueError(
+                        f"route row {label!r} field {key!r} must be finite and "
+                        f">= 0, got {v!r}"
+                    )
+            n = row.get("samples")
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+                raise ValueError(
+                    f"route row {label!r} field 'samples' must be a positive "
+                    f"int, got {n!r}"
+                )
 
 
 def load_profile(path) -> MachineProfile:
@@ -191,6 +273,25 @@ def _bw_ici(bw: Optional[float], profile: Optional[MachineProfile]) -> float:
     if profile is not None:
         return profile.bw_ici
     return BW_ICI_10GBPS
+
+
+def route_measurement(
+    profile: Optional[MachineProfile], label: str
+) -> Optional[Dict[str, float]]:
+    """The profile's fitted per-route row as a flat measurement fragment
+    (``{"t_encode_s", "t_decode_s"}`` — the spelling the measurement-row
+    plumbing uses), or None when the profile carries no row for `label`.
+    This is the join point between calibrate()'s v2 `routes` table and the
+    selectors' existing `measurements` parameter."""
+    if profile is None:
+        return None
+    row = profile.routes.get(label)
+    if row is None:
+        return None
+    return {
+        "t_encode_s": float(row["t_enc_s"]),
+        "t_decode_s": float(row["t_dec_s"]),
+    }
 
 
 def dense_measurement(d: int) -> Dict[str, float]:
@@ -519,26 +620,32 @@ def select_rs_mode(
     cap_headroom: float = 2.0,
     bw: Optional[float] = None,
     modes: Optional[tuple] = None,
+    measurements: Optional[Dict[str, Dict[str, float]]] = None,
     compute_time: float = 0.0,
     profile: Optional[MachineProfile] = None,
 ) -> str:
     """Resolve ``rs_mode="auto"`` at construction time: argmin of the
-    wire-only W-aware model over the concrete routes. At the 100 Mbps
-    default link the step is wire-dominated, so compute terms (which need
-    per-platform measurement) are deliberately excluded — the selector is
-    deterministic from (d, W, ratio) and static config alone.
-    ``compute_time`` (hideable backward compute, see `overlapped_step_time`)
-    threads through to each candidate's `rs_step_time`; the default 0
-    keeps the historical selection. ``profile`` prices the candidates at a
-    calibrated bandwidth — note every rs route's time is wire-only and
-    scales as 1/bw, so a bandwidth-only profile can never flip this argmin
-    (that is a property of the model, not a bug; the hierarchical planner
-    is where fitted encode/decode costs change picks)."""
+    W-aware model over the concrete routes. At the 100 Mbps default link
+    the step is wire-dominated and, with no measured rows, compute terms
+    are excluded — the selector is deterministic from (d, W, ratio) and
+    static config alone. ``measurements[mode]`` rows (t_encode_s/t_decode_s
+    per route, the bench measurement convention) charge each candidate its
+    measured codec compute; when absent, a ``profile`` with fitted
+    per-route `routes` rows fills them in, so a calibrated profile
+    re-ranks the routes on measured encode/decode, not just bandwidth (a
+    bandwidth-only v1 profile still can never flip this argmin — every rs
+    route's wire scales as 1/bw). ``compute_time`` (hideable backward
+    compute, see `overlapped_step_time`) threads through to each
+    candidate's `rs_step_time`; the default 0 keeps the historical
+    selection."""
     candidates = modes or ("sparse", "adaptive", "quantized", "sketch", "oktopk")
     best, best_t = None, float("inf")
     for mode in candidates:
+        m = (measurements or {}).get(mode) or route_measurement(profile, mode)
+        tc = (m["t_encode_s"] + m["t_decode_s"]) if m else 0.0
         t = rs_step_time(
             mode, d, W, ratio,
+            t_compute_s=tc,
             headroom=headroom, out_headroom=out_headroom,
             block=block, rows=rows, cols=cols,
             bins=bins, cap_headroom=cap_headroom, bw=bw,
@@ -622,21 +729,32 @@ def hier_dcn_time(
     time`): it shaves every leg's wire before the formulas above, so the
     planner can price what streaming buys on the scarce link; 0 keeps the
     historical model. A ``profile`` supplies its calibrated bandwidth AND
-    fills the default fused/bucketed measurement row with the fitted
-    encode/decode seconds — the one place a fitted profile can genuinely
-    flip a plan (the rs legs are wire-only and bandwidth-scale-invariant)."""
+    fills the measurement gaps with its fitted encode/decode seconds: a
+    per-route `routes` row for the leg wins over the global t_enc/t_dec
+    fallback on the fused/bucketed legs, and charges the rs legs one
+    encode + one decode of codec compute — so a v2 profile can flip plans
+    on ANY leg, not just the gather-then-decode family (explicit
+    `measurement`/`t_compute_s` still win)."""
     bw_dcn = _bw_dcn(bw_dcn, profile)
+    rm = route_measurement(profile, leg)
     if leg in ("fused", "bucketed"):
-        m = measurement or {
-            "payload_bytes": 8.0 * max(1, int(d * ratio)),
-            "t_encode_s": profile.t_enc_s if profile is not None else 0.0,
-            "t_decode_s": profile.t_dec_s if profile is not None else 0.0,
-        }
+        if measurement is not None:
+            m = measurement
+        elif rm is not None:
+            m = {"payload_bytes": 8.0 * max(1, int(d * ratio)), **rm}
+        else:
+            m = {
+                "payload_bytes": 8.0 * max(1, int(d * ratio)),
+                "t_encode_s": profile.t_enc_s if profile is not None else 0.0,
+                "t_decode_s": profile.t_dec_s if profile is not None else 0.0,
+            }
         wire = allgather_time(m["payload_bytes"], n_slices, bw_dcn)
         wire = max(0.0, wire - max(0.0, compute_time))
         if leg == "bucketed":
             return m["t_encode_s"] + max(wire, n_slices * m["t_decode_s"])
         return m["t_encode_s"] + wire + n_slices * m["t_decode_s"]
+    if t_compute_s == 0.0 and rm is not None:
+        t_compute_s = rm["t_encode_s"] + rm["t_decode_s"]
     return rs_step_time(
         leg, d, n_slices, ratio, t_compute_s=t_compute_s, bw=bw_dcn,
         compute_time=compute_time, **_rs_kw(kw)
@@ -800,13 +918,23 @@ def drop_warmup(xs: Sequence[float], k: float = 4.0) -> List[float]:
     return xs[i:]
 
 
-def span_self_times(events) -> Dict[str, float]:
-    """Per-span-name SELF time in seconds from Chrome-trace "X" events:
-    each span's duration minus its direct children's, computed with a
-    per-(pid, tid) interval stack — so a container like
+def _span_route(e: Dict[str, Any]) -> str:
+    """The event's route attribution ("" when unlabeled)."""
+    args = e.get("args")
+    if isinstance(args, dict) and isinstance(args.get("route"), str):
+        return args["route"]
+    return ""
+
+
+def span_self_times_by_route(events) -> Dict[Tuple[str, str], float]:
+    """Per-(span-name, route) SELF time in seconds from Chrome-trace "X"
+    events: each span's duration minus its direct children's, computed with
+    a per-(pid, tid) interval stack — so a container like
     train/forward_backward is not double-charged for the exchange spans a
-    streaming run nests inside it."""
-    by_tid: Dict[Any, List[Tuple[float, float, str]]] = {}
+    streaming run nests inside it, and a wire span nested inside a labeled
+    encode span keeps its time out of that route's encode row. Unlabeled
+    spans key under route ""."""
+    by_tid: Dict[Any, List[Tuple[float, float, str, str]]] = {}
     for e in events:
         if e.get("ph") != "X":
             continue
@@ -815,23 +943,34 @@ def span_self_times(events) -> Dict[str, float]:
             continue
         key = (e.get("pid"), e.get("tid"))
         by_tid.setdefault(key, []).append(
-            (float(ts), float(dur), str(e.get("name", "")))
+            (float(ts), float(dur), str(e.get("name", "")), _span_route(e))
         )
-    self_us: Dict[str, float] = {}
+    self_us: Dict[Tuple[str, str], float] = {}
     for evs in by_tid.values():
         # parents sort before children: earlier start first, longer first on
         # ties (a child can share its parent's start timestamp)
         evs.sort(key=lambda t: (t[0], -t[1]))
-        stack: List[Tuple[float, str]] = []  # (end_ts, name)
-        for ts, dur, name in evs:
+        stack: List[Tuple[float, Tuple[str, str]]] = []  # (end_ts, key)
+        for ts, dur, name, route in evs:
             while stack and ts >= stack[-1][0]:
                 stack.pop()
-            self_us[name] = self_us.get(name, 0.0) + dur
+            k = (name, route)
+            self_us[k] = self_us.get(k, 0.0) + dur
             if stack:
                 parent = stack[-1][1]
                 self_us[parent] = self_us.get(parent, 0.0) - dur
-            stack.append((ts + dur, name))
-    return {name: us * 1e-6 for name, us in self_us.items()}
+            stack.append((ts + dur, k))
+    return {k: us * 1e-6 for k, us in self_us.items()}
+
+
+def span_self_times(events) -> Dict[str, float]:
+    """Per-span-name SELF time in seconds — `span_self_times_by_route`
+    aggregated over the route attribution (the pre-v2 view; adding route
+    labels to spans cannot change these totals)."""
+    out: Dict[str, float] = {}
+    for (name, _route), s in span_self_times_by_route(events).items():
+        out[name] = out.get(name, 0.0) + s
+    return out
 
 
 def _read_json(path: pathlib.Path) -> Dict[str, Any]:
@@ -862,7 +1001,10 @@ def calibrate(
             f"{run}: no span trace (trace.json) — re-run with --telemetry "
             "to record the spans the fit decomposes"
         )
-    self_s = span_self_times(events)
+    routed_s = span_self_times_by_route(events)
+    self_s: Dict[str, float] = {}
+    for (name, _route), s in routed_s.items():
+        self_s[name] = self_s.get(name, 0.0) + s
 
     # --- measured step time: train/step spans, else metrics.jsonl ts ---- #
     step_durs = sorted(
@@ -894,6 +1036,13 @@ def calibrate(
         )
     n_total = len(samples)
     kept = samples if include_warmup else drop_warmup(samples, k=warmup_k)
+    if len(kept) < 4:
+        raise ValueError(
+            f"{run}: too few step-time samples to fit from — the run has "
+            f"{n_total} sample(s), {len(kept)} left after the warmup drop; "
+            "the share-based fit needs >= 4 post-warmup samples "
+            "(re-run with more steps)"
+        )
     T = sum(kept) / len(kept)
     if T <= 0.0:
         raise ValueError(f"{run}: measured mean step time is not positive")
@@ -919,6 +1068,35 @@ def calibrate(
     enc_s, dec_s = enc_tr * scale, dec_tr * scale
     wdcn_s, wici_s = wdcn_tr * scale, wici_tr * scale
     comp_s, other_s = comp_tr * scale, other_tr * scale
+
+    # --- per-route encode/decode rows (the v2 `routes` table) ----------- #
+    # labeled encode/decode self-time buckets per route BEFORE the share
+    # fit; the same trace-time -> step-time scale apportions each bucket,
+    # so the route rows sum (up to the decode-multiplicity convention) to
+    # the global enc_s/dec_s they were split out of.
+    enc_tr_route: Dict[str, float] = {}
+    dec_tr_route: Dict[str, float] = {}
+    for (name, route), s in routed_s.items():
+        if not route or s <= 0.0:
+            continue
+        if name in CAL_ENCODE_SPANS:
+            enc_tr_route[route] = enc_tr_route.get(route, 0.0) + s
+        elif name in CAL_DECODE_SPANS:
+            dec_tr_route[route] = dec_tr_route.get(route, 0.0) + s
+    route_samples: Dict[str, int] = {}
+    for e in events:
+        nm, route = str(e.get("name", "")), _span_route(e)
+        if route and (nm in CAL_ENCODE_SPANS or nm in CAL_DECODE_SPANS):
+            route_samples[route] = route_samples.get(route, 0) + 1
+    routes: Dict[str, Dict[str, float]] = {}
+    for label in sorted(set(enc_tr_route) | set(dec_tr_route)):
+        routes[label] = {
+            "t_enc_s": enc_tr_route.get(label, 0.0) * scale,
+            "t_dec_s": dec_tr_route.get(label, 0.0)
+            * scale
+            / _route_decodes_per_step(label, W),
+            "samples": route_samples.get(label, 1),
+        }
 
     # --- wire counters (per-worker injection bytes per step) ------------ #
     telem = _read_json(run / "summary.json").get("telemetry") or {}
@@ -1001,5 +1179,6 @@ def calibrate(
         compute_time_s=compute_time,
         fitted=tuple(fitted),
         fixed=tuple(fixed),
+        routes=routes,
         source=source,
     )

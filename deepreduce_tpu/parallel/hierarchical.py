@@ -224,8 +224,10 @@ class HierarchicalExchanger:
 
                 # encode/decode sub-spans inside the ici leg: calibrate()
                 # charges them to t_enc/t_dec (self-time keeps the wire
-                # share in exchange/ici itself)
-                with spans.span("exchange/encode"):
+                # share in exchange/ici itself). Route "qar" keeps this
+                # codec's row distinct from the DCN leg's — ici-leg encode
+                # must not pollute a DCN route's fitted seconds.
+                with spans.span("exchange/encode", route="qar"):
                     flat, unravel = ravel_pytree(grads)
                     d = flat.shape[0]
                     n = qar.pad_len(d, n_ici, self.cfg.bucket_size)
@@ -240,7 +242,7 @@ class HierarchicalExchanger:
                     bucket_size=self.cfg.bucket_size,
                     use_pallas=self.cfg.use_pallas,
                 )
-                with spans.span("exchange/decode"):
+                with spans.span("exchange/decode", route="qar"):
                     slice_mean = unravel(mean[:d].astype(flat.dtype))
                 ici_bits += qar.wire_bits_per_worker(d, n_ici, self.cfg.bucket_size)
             else:

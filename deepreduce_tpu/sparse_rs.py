@@ -227,14 +227,14 @@ def exchange(
     )
 
 
-def _phase1_route(flat, axis_name, W, S, B, *, ratio, approx_topk):
+def _phase1_route(flat, axis_name, W, S, B, *, ratio, approx_topk, route=None):
     """Shared phase 1: top-k select, route entries to their shard-owners
     through one all_to_all, scatter-add into the owner's dense shard.
     Returns (shard_buf f32[S], keep mask, routed idxs/vals, pos) — the
     latter three feed the own-transmitted EF scatter."""
     # sort_indices=False keeps lax.top_k's descending-|v| order — the
     # overflow-drop-smallest property below depends on it
-    with spans.span("sparse_rs/select"):
+    with spans.span("sparse_rs/select", route=route):
         sp = sparse.topk(flat, ratio, sort_indices=False, approx=approx_topk)
     k = sp.k
 
@@ -273,14 +273,14 @@ def _phase1_route(flat, axis_name, W, S, B, *, ratio, approx_topk):
         [send_v.astype(jnp.float32),
          jax.lax.bitcast_convert_type(send_i, jnp.float32)], axis=1
     )  # [W, 2B]
-    with spans.span("sparse_rs/route"):
+    with spans.span("sparse_rs/route", route=route):
         rx = jax.lax.all_to_all(
             send_buf, axis_name, split_axis=0, concat_axis=0, tiled=True
         )
     rx_v = rx[:, :B]
     rx_i = jax.lax.bitcast_convert_type(rx[:, B:], jnp.int32)
 
-    with spans.span("sparse_rs/reduce"):
+    with spans.span("sparse_rs/reduce", route=route):
         shard_buf = jnp.zeros((S,), jnp.float32).at[rx_i.reshape(-1)].add(
             rx_v.reshape(-1).astype(jnp.float32)
         )
@@ -337,7 +337,7 @@ def _exchange_sparse(
 
     # sort_indices=False keeps lax.top_k's descending-|v| order — the
     # overflow-drop-smallest property below depends on it
-    with spans.span("sparse_rs/select"):
+    with spans.span("sparse_rs/select", route="sparse"):
         sp = sparse.topk(flat, ratio, sort_indices=False, approx=approx_topk)
     k = sp.k
 
@@ -377,7 +377,7 @@ def _exchange_sparse(
         [send_v.astype(jnp.float32),
          jax.lax.bitcast_convert_type(send_i, jnp.float32)], axis=1
     )  # [W, 2B]
-    with spans.span("sparse_rs/route"):
+    with spans.span("sparse_rs/route", route="sparse"):
         rx = jax.lax.all_to_all(
             send_buf, axis_name, split_axis=0, concat_axis=0, tiled=True
         )
@@ -385,7 +385,7 @@ def _exchange_sparse(
     rx_i = jax.lax.bitcast_convert_type(rx[:, B:], jnp.int32)
 
     # --- reduce my shard ------------------------------------------------- #
-    with spans.span("sparse_rs/reduce"):
+    with spans.span("sparse_rs/reduce", route="sparse"):
         shard_buf = jnp.zeros((S,), jnp.float32).at[rx_i.reshape(-1)].add(
             rx_v.reshape(-1).astype(jnp.float32)
         )
@@ -401,7 +401,7 @@ def _exchange_sparse(
         [out_vals.astype(jnp.float32),
          jax.lax.bitcast_convert_type(out_idx, jnp.float32)]
     )  # [2*K2]
-    with spans.span("sparse_rs/allgather"):
+    with spans.span("sparse_rs/allgather", route="sparse"):
         gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
     gathered_v = gathered[:, :K2]
     gathered_i = jax.lax.bitcast_convert_type(gathered[:, K2:], jnp.int32)
@@ -454,7 +454,8 @@ def _exchange_adaptive(
     q = 127  # per-row dequantize is per-worker — no summation, full int8 range
 
     shard_buf, keep, idxs, vals, pos = _phase1_route(
-        flat, axis_name, W, S, B, ratio=ratio, approx_topk=approx_topk
+        flat, axis_name, W, S, B, ratio=ratio, approx_topk=approx_topk,
+        route="adaptive",
     )
     widx = jax.lax.axis_index(axis_name)
 
@@ -470,7 +471,7 @@ def _exchange_adaptive(
     sparse_row = jnp.zeros((L,), jnp.float32).at[: 2 * K2].set(
         _phase2_pack(shard_buf, widx, S, K2)
     )
-    with spans.span("sparse_rs/adaptive-quantize"):
+    with spans.span("sparse_rs/adaptive-quantize", route="adaptive"):
         levels, norms = qar.bucket_quantize(
             jnp.zeros((Sp,), jnp.float32).at[:S].set(shard_buf),
             q, block, jax.random.fold_in(key, widx),
@@ -482,7 +483,7 @@ def _exchange_adaptive(
     row = jnp.concatenate(
         [jnp.where(go_dense > 0.5, dense_row, sparse_row), go_dense[None]]
     )  # [L+1]
-    with spans.span("sparse_rs/allgather"):
+    with spans.span("sparse_rs/allgather", route="adaptive"):
         gathered = jax.lax.all_gather(row, axis_name)  # [W, L+1]
 
     # --- decode both interpretations, select on the flag ----------------- #
@@ -543,13 +544,13 @@ def _exchange_quantized(
     # its local block L2 norm, hence by the shared max — so each stochastic
     # level is <= q and the W-worker int8 sum cannot exceed W*q <= 127
     norms_local = jnp.linalg.norm(gp.reshape(-1, block), axis=1)
-    with spans.span("sparse_rs/norm-pmax"):
+    with spans.span("sparse_rs/norm-pmax", route="quantized"):
         norms_shared = jax.lax.pmax(norms_local, axis_name)
-    with spans.span("sparse_rs/quantize"):
+    with spans.span("sparse_rs/quantize", route="quantized"):
         levels, _ = qar.bucket_quantize(
             gp, q, block, jax.random.fold_in(key, widx), norms=norms_shared
         )
-    with spans.span("sparse_rs/reduce-scatter"):
+    with spans.span("sparse_rs/reduce-scatter", route="quantized"):
         summed = jax.lax.psum_scatter(
             levels, axis_name, scatter_dimension=0, tiled=True
         )  # int8[Ssh] — exact: levels bounded so the sum never wraps
@@ -560,7 +561,7 @@ def _exchange_quantized(
 
     # --- phase 2: sparse re-select + allgather --------------------------- #
     out_buf = _phase2_pack(shard_est, widx, Ssh, K2)
-    with spans.span("sparse_rs/allgather"):
+    with spans.span("sparse_rs/allgather", route="quantized"):
         gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
     _, gi, dense = _phase2_unpack(gathered, K2, W, Ssh)
     mean = dense[:d] / W
@@ -596,25 +597,25 @@ def _exchange_sketch(
     C = cols if cols > 0 else max(256, int(math.ceil(2.0 * k / max(1, rows))))
     widx = jax.lax.axis_index(axis_name)
 
-    with spans.span("sparse_rs/select"):
+    with spans.span("sparse_rs/select", route="sketch"):
         sp = sparse.topk_sampled(flat, ratio, k=k)
     live = jnp.arange(sp.k, dtype=jnp.int32) < sp.nnz
     sel_vals = jnp.where(live, sp.values, 0.0)
-    with spans.span("sparse_rs/sketch"):
+    with spans.span("sparse_rs/sketch", route="sketch"):
         sk = countsketch.sketch_from_sparse(
             sel_vals, sp.indices, rows, C, seed=seed
         )
-    with spans.span("sparse_rs/psum"):
+    with spans.span("sparse_rs/psum", route="sketch"):
         summed = jax.lax.psum(sk, axis_name)  # linear: sketch of the sum
 
     # --- unsketch my shard only ------------------------------------------ #
-    with spans.span("sparse_rs/unsketch"):
+    with spans.span("sparse_rs/unsketch", route="sketch"):
         shard_idx = jnp.arange(S, dtype=jnp.int32) + widx * S
         shard_est = countsketch.unsketch_at(summed, shard_idx, seed=seed)
 
     # --- phase 2: sparse re-select + allgather --------------------------- #
     out_buf = _phase2_pack(shard_est, widx, S, K2)
-    with spans.span("sparse_rs/allgather"):
+    with spans.span("sparse_rs/allgather", route="sketch"):
         gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
     _, gi, dense = _phase2_unpack(gathered, K2, W, S)
     mean = dense[:d] / W
@@ -653,87 +654,97 @@ def _exchange_oktopk(
     K2 = out_budget(d, ratio, W, out_headroom)
     shift = oktopk_shift(bins)
 
-    # --- candidates: local exact top-k (descending |v| order) ----------- #
-    with spans.span("sparse_rs/select"):
-        sp = sparse.topk(flat, ratio, sort_indices=False, approx=False)
-    k = sp.k
-    live = jnp.arange(k, dtype=jnp.int32) < sp.nnz
-    mag = jnp.where(live, jnp.abs(sp.values), 0.0).astype(jnp.float32)
+    # encode phase (histogram + select + routing pack) under an
+    # exchange/encode sub-span so calibrate can see this route's codec
+    # compute; the nested wire spans (psum) keep their own category — the
+    # interval-stack self-time subtraction never double-charges them
+    with spans.span("exchange/encode", route="oktopk"):
+        # --- candidates: local exact top-k (descending |v| order) ------- #
+        with spans.span("sparse_rs/select", route="oktopk"):
+            sp = sparse.topk(flat, ratio, sort_indices=False, approx=False)
+        k = sp.k
+        live = jnp.arange(k, dtype=jnp.int32) < sp.nnz
+        mag = jnp.where(live, jnp.abs(sp.values), 0.0).astype(jnp.float32)
 
-    # --- global threshold from one psum'd histogram --------------------- #
-    # non-negative f32 bit patterns sort like the values, so the shifted
-    # pattern is a shared magnitude bucket — no scale agreement (no pmax)
-    bucket = jnp.right_shift(
-        jax.lax.bitcast_convert_type(mag, jnp.int32), shift
-    )
-    weight = jnp.logical_and(live, mag > 0.0).astype(jnp.float32)
-    hist = jnp.zeros((bins,), jnp.float32).at[bucket].add(weight)
-    # zero-weight dead slots land in bucket 0: adding 0 is exact
-    with spans.span("sparse_rs/psum"):
-        g_hist = jax.lax.psum(hist, axis_name)
-    # cum[j] = global count of candidates in bucket >= j; the threshold is
-    # the HIGHEST bucket still admitting >= k entries. All-false (fewer
-    # than k nonzero candidates in the whole mesh) degrades to bucket 0 —
-    # every nonzero entry survives, which is correct: total < k.
-    cum = jnp.flip(jnp.cumsum(jnp.flip(g_hist)))
-    ok = cum >= float(k)
-    b_star = jnp.max(
-        jnp.where(ok, jnp.arange(bins, dtype=jnp.int32), 0)
-    )
-    survive = jnp.logical_and(
-        jnp.logical_and(live, mag > 0.0), bucket >= b_star
-    )
+        # --- global threshold from one psum'd histogram ----------------- #
+        # non-negative f32 bit patterns sort like the values, so the shifted
+        # pattern is a shared magnitude bucket — no scale agreement (no pmax)
+        bucket = jnp.right_shift(
+            jax.lax.bitcast_convert_type(mag, jnp.int32), shift
+        )
+        weight = jnp.logical_and(live, mag > 0.0).astype(jnp.float32)
+        hist = jnp.zeros((bins,), jnp.float32).at[bucket].add(weight)
+        # zero-weight dead slots land in bucket 0: adding 0 is exact
+        with spans.span("sparse_rs/psum", route="oktopk"):
+            g_hist = jax.lax.psum(hist, axis_name)
+        # cum[j] = global count of candidates in bucket >= j; the threshold
+        # is the HIGHEST bucket still admitting >= k entries. All-false
+        # (fewer than k nonzero candidates in the whole mesh) degrades to
+        # bucket 0 — every nonzero entry survives, which is correct:
+        # total < k.
+        cum = jnp.flip(jnp.cumsum(jnp.flip(g_hist)))
+        ok = cum >= float(k)
+        b_star = jnp.max(
+            jnp.where(ok, jnp.arange(bins, dtype=jnp.int32), 0)
+        )
+        survive = jnp.logical_and(
+            jnp.logical_and(live, mag > 0.0), bucket >= b_star
+        )
 
-    # --- balanced routing: survivors only, capacity Bo per pair --------- #
-    shard_of = jnp.where(survive, sp.indices // S, W)  # dead -> parked W
-    # stable sort by shard keeps the descending-|v| candidate order within
-    # each shard, so capacity overflow drops the smallest magnitudes
-    order = jnp.argsort(shard_of, stable=True)
-    sh = shard_of[order]
-    vals = sp.values[order]
-    idxs = sp.indices[order]
-    pos = jnp.arange(k, dtype=jnp.int32)
-    first_of_run = jnp.where(
-        jnp.concatenate([jnp.ones((1,), bool), sh[1:] != sh[:-1]]), pos, -1
-    )
-    run_start = jax.lax.cummax(first_of_run)
-    rank = pos - run_start
-    keep = jnp.logical_and(sh < W, rank < Bo)
-    tgt = jnp.where(keep, sh * Bo + rank, W * Bo + pos)
-    send_v = (
-        jnp.zeros((W * Bo,), flat.dtype)
-        .at[tgt].set(vals, mode="drop", unique_indices=True)
-        .reshape(W, Bo)
-    )
-    send_i = (
-        jnp.zeros((W * Bo,), jnp.int32)
-        .at[tgt].set(idxs - sh * S, mode="drop", unique_indices=True)
-        .reshape(W, Bo)
-    )
-    send_buf = jnp.concatenate(
-        [send_v.astype(jnp.float32),
-         jax.lax.bitcast_convert_type(send_i, jnp.float32)], axis=1
-    )  # [W, 2*Bo]
-    with spans.span("sparse_rs/route"):
+        # --- balanced routing: survivors only, capacity Bo per pair ----- #
+        shard_of = jnp.where(survive, sp.indices // S, W)  # dead -> parked W
+        # stable sort by shard keeps the descending-|v| candidate order
+        # within each shard, so capacity overflow drops the smallest
+        # magnitudes
+        order = jnp.argsort(shard_of, stable=True)
+        sh = shard_of[order]
+        vals = sp.values[order]
+        idxs = sp.indices[order]
+        pos = jnp.arange(k, dtype=jnp.int32)
+        first_of_run = jnp.where(
+            jnp.concatenate([jnp.ones((1,), bool), sh[1:] != sh[:-1]]), pos, -1
+        )
+        run_start = jax.lax.cummax(first_of_run)
+        rank = pos - run_start
+        keep = jnp.logical_and(sh < W, rank < Bo)
+        tgt = jnp.where(keep, sh * Bo + rank, W * Bo + pos)
+        send_v = (
+            jnp.zeros((W * Bo,), flat.dtype)
+            .at[tgt].set(vals, mode="drop", unique_indices=True)
+            .reshape(W, Bo)
+        )
+        send_i = (
+            jnp.zeros((W * Bo,), jnp.int32)
+            .at[tgt].set(idxs - sh * S, mode="drop", unique_indices=True)
+            .reshape(W, Bo)
+        )
+        send_buf = jnp.concatenate(
+            [send_v.astype(jnp.float32),
+             jax.lax.bitcast_convert_type(send_i, jnp.float32)], axis=1
+        )  # [W, 2*Bo]
+    with spans.span("sparse_rs/route", route="oktopk"):
         rx = jax.lax.all_to_all(
             send_buf, axis_name, split_axis=0, concat_axis=0, tiled=True
         )
-    rx_v = rx[:, :Bo]
-    rx_i = jax.lax.bitcast_convert_type(rx[:, Bo:], jnp.int32)
-    with spans.span("sparse_rs/reduce"):
-        shard_buf = jnp.zeros((S,), jnp.float32).at[rx_i.reshape(-1)].add(
-            rx_v.reshape(-1).astype(jnp.float32)
-        )
+    # decode phase (scatter-reduce + phase-2 re-select + unpack) under an
+    # exchange/decode sub-span; the nested allgather stays wire
+    with spans.span("exchange/decode", route="oktopk"):
+        rx_v = rx[:, :Bo]
+        rx_i = jax.lax.bitcast_convert_type(rx[:, Bo:], jnp.int32)
+        with spans.span("sparse_rs/reduce", route="oktopk"):
+            shard_buf = jnp.zeros((S,), jnp.float32).at[rx_i.reshape(-1)].add(
+                rx_v.reshape(-1).astype(jnp.float32)
+            )
 
-    # --- phase 2: sparse re-select + allgather --------------------------- #
-    widx = jax.lax.axis_index(axis_name)
-    out_buf = _phase2_pack(shard_buf, widx, S, K2)
-    with spans.span("sparse_rs/allgather"):
-        gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
-    _, _, dense = _phase2_unpack(gathered, K2, W, S)
-    mean = dense[:d] / W
+        # --- phase 2: sparse re-select + allgather ---------------------- #
+        widx = jax.lax.axis_index(axis_name)
+        out_buf = _phase2_pack(shard_buf, widx, S, K2)
+        with spans.span("sparse_rs/allgather", route="oktopk"):
+            gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
+        _, _, dense = _phase2_unpack(gathered, K2, W, S)
+        mean = dense[:d] / W
 
-    own_dense = _own_transmitted(flat, keep, idxs, vals, pos, W, S, d)
+        own_dense = _own_transmitted(flat, keep, idxs, vals, pos, W, S, d)
 
     if collect is not None:
         # survivors: the global count the threshold admitted (identical on

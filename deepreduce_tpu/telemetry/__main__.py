@@ -50,7 +50,19 @@ offline consumer of tracking.py run directories.
                              report each sweep point where the static pick
                              and the calibrated pick disagree (and what
                              the static pick costs under the fitted
-                             model). Informational — exits 0.
+                             model). Informational — exits 0. Pass
+                             ``--profile`` twice to compare the picks of
+                             two fitted profiles (A vs B) instead of
+                             static vs fitted.
+- ``profiles A.json B.json [...] --against BENCH.json``
+                             cross-profile drift sentinel: per-parameter
+                             drift between saved machine profiles,
+                             per-route row disagreement from their v2
+                             route tables, and — the load-bearing part —
+                             which committed bench plan selections flip
+                             between profiles. Exits 1 when any
+                             ``--against`` sweep point's pick differs
+                             between any two of the profiles.
 
 Step-time statistics drop compile-dominated warmup intervals by default
 (``--include-warmup`` keeps them). Runs with telemetry off get a clean
@@ -423,6 +435,52 @@ def _profile_points(detail: Dict[str, Any]) -> Optional[List[Dict[str, Any]]]:
     return None
 
 
+def _point_pick(pt: Dict[str, Any], prof) -> Optional[tuple]:
+    """Plan pick for one bench sweep point under a machine profile
+    (prof=None selects with the static constants). Hier-shaped points
+    (n_slices/per_slice) go through `select_hier_plan`; rs-shaped points
+    (`workers`) through `select_rs_mode`. Returns
+    (label, pick_key, modeled_step_s) or None when the point carries
+    neither shape."""
+    d = int(pt.get("d", 0))
+    ratio = float(pt.get("ratio", 0.0))
+    if not d:
+        return None
+    if "n_slices" in pt and "per_slice" in pt:
+        n_slices, per_slice = int(pt["n_slices"]), int(pt["per_slice"])
+        plan = costmodel.select_hier_plan(
+            d, n_slices, per_slice, ratio, profile=prof
+        )
+        key = f"{plan['ici']}+{plan['dcn']}"
+        label = f"d={d} ratio={ratio:g} {n_slices}x{per_slice}"
+        return (label, key, float(plan["modeled_step_s"]))
+    if "workers" in pt:
+        W = int(pt["workers"])
+        mode = costmodel.select_rs_mode(d, W, ratio, profile=prof)
+        t = costmodel.rs_step_time(mode, d, W, ratio, profile=prof)
+        return (f"d={d} ratio={ratio:g} W={W}", mode, float(t))
+    return None
+
+
+def _point_price(pt: Dict[str, Any], key: str, prof) -> Optional[float]:
+    """Price a specific pick `key` for a sweep point under a profile —
+    what the other side's choice would cost on this machine."""
+    d = int(pt.get("d", 0))
+    ratio = float(pt.get("ratio", 0.0))
+    if "n_slices" in pt and "per_slice" in pt:
+        plan = costmodel.select_hier_plan(
+            d, int(pt["n_slices"]), int(pt["per_slice"]), ratio, profile=prof
+        )
+        t = plan["table"].get(key)
+        return float(t) if t is not None else None
+    if "workers" in pt:
+        return float(
+            costmodel.rs_step_time(key, d, int(pt["workers"]), ratio,
+                                   profile=prof)
+        )
+    return None
+
+
 def _compare_profile(args) -> int:
     """`compare --profile P --against BENCH.json`: re-price a committed
     bench claim under a fitted machine profile. For each hier-shaped sweep
@@ -430,12 +488,23 @@ def _compare_profile(args) -> int:
     are compared; when they disagree, the static pick is also priced under
     the fitted model to show what the constants would have cost on this
     machine. rs-shaped points (`workers` instead of slices) get the same
-    treatment through `select_rs_mode` — whose argmin is bandwidth-scale-
-    invariant, so only the absolute times move. Informational: exits 0."""
-    try:
-        prof = costmodel.load_profile(args.profile)
-    except (OSError, ValueError) as e:
-        return _fail(f"cannot load profile {args.profile!r}: {e}")
+    treatment through `select_rs_mode`. Passing --profile TWICE compares
+    profile-A picks against profile-B picks instead of static vs fitted.
+    Informational: exits 0 — the exit-code-gated cross-profile sentinel
+    is the `profiles` subcommand."""
+    if len(args.profile) > 2:
+        return _fail("compare takes at most two --profile flags")
+    profs = []
+    for path in args.profile:
+        try:
+            profs.append((path, costmodel.load_profile(path)))
+        except (OSError, ValueError) as e:
+            return _fail(f"cannot load profile {path!r}: {e}")
+    if len(profs) == 2:
+        (name_a, prof_a), (name_b, prof_b) = profs
+    else:
+        name_a, prof_a = "static", None
+        name_b, prof_b = profs[0]
     bench = _load_json(pathlib.Path(args.against))
     if not bench:
         return _fail(f"cannot read bench record {args.against!r}")
@@ -446,58 +515,38 @@ def _compare_profile(args) -> int:
             f"{args.against!r} has no profile-repriceable sweep points "
             "(need detail.points, or d/ratio/n_slices/per_slice in detail)"
         )
-    print(f"re-pricing {args.against} under profile {args.profile}")
-    print(
-        f"  profile: bw_dcn {prof.bw_dcn:.4g} B/s  bw_ici {prof.bw_ici:.4g} "
-        f"B/s  t_enc {prof.t_enc_s:.4g}s  t_dec {prof.t_dec_s:.4g}s  "
-        f"(fitted: {', '.join(prof.fitted) or 'none'})"
-    )
+    print(f"re-pricing {args.against}: {name_a} vs {name_b}")
+    for name, prof in profs:
+        print(
+            f"  {name}: bw_dcn {prof.bw_dcn:.4g} B/s  bw_ici "
+            f"{prof.bw_ici:.4g} B/s  t_enc {prof.t_enc_s:.4g}s  t_dec "
+            f"{prof.t_dec_s:.4g}s  {len(prof.routes)} route row(s)  "
+            f"(fitted: {', '.join(prof.fitted) or 'none'})"
+        )
     disagreements = 0
     for pt in points:
-        d = int(pt.get("d", 0))
-        ratio = float(pt.get("ratio", 0.0))
-        if not d:
+        got_a = _point_pick(pt, prof_a)
+        got_b = _point_pick(pt, prof_b)
+        if got_a is None or got_b is None:
             continue
-        if "n_slices" in pt and "per_slice" in pt:
-            n_slices, per_slice = int(pt["n_slices"]), int(pt["per_slice"])
-            static = costmodel.select_hier_plan(d, n_slices, per_slice, ratio)
-            calib = costmodel.select_hier_plan(
-                d, n_slices, per_slice, ratio, profile=prof
-            )
-            s_key = f"{static['ici']}+{static['dcn']}"
-            c_key = f"{calib['ici']}+{calib['dcn']}"
-            static_under_fitted = calib["table"][s_key]
-            label = f"d={d} ratio={ratio:g} {n_slices}x{per_slice}"
-        elif "workers" in pt:
-            W = int(pt["workers"])
-            s_mode = costmodel.select_rs_mode(d, W, ratio)
-            c_mode = costmodel.select_rs_mode(d, W, ratio, profile=prof)
-            s_key, c_key = s_mode, c_mode
-            static_under_fitted = costmodel.rs_step_time(
-                s_mode, d, W, ratio, profile=prof
-            )
-            calib = {
-                "modeled_step_s": costmodel.rs_step_time(
-                    c_mode, d, W, ratio, profile=prof
-                )
-            }
-            label = f"d={d} ratio={ratio:g} W={W}"
-        else:
-            continue
-        if s_key == c_key:
+        label, a_key, _ = got_a
+        _, b_key, b_time = got_b
+        if a_key == b_key:
             print(
-                f"  {label}: static and calibrated agree on {s_key} "
-                f"({calib['modeled_step_s']:.6g}s under fitted model)"
+                f"  {label}: {name_a} and {name_b} agree on {a_key} "
+                f"({b_time:.6g}s under {name_b}'s model)"
             )
         else:
             disagreements += 1
-            print(
-                f"  {label}: DISAGREE — static picks {s_key} "
-                f"({static_under_fitted:.6g}s under fitted model), "
-                f"calibrated picks {c_key} "
-                f"({calib['modeled_step_s']:.6g}s, "
-                f"{static_under_fitted / calib['modeled_step_s']:.2f}x better)"
+            a_under_b = _point_price(pt, a_key, prof_b)
+            priced = (
+                f"({a_under_b:.6g}s under {name_b}'s model), "
+                f"{name_b} picks {b_key} ({b_time:.6g}s, "
+                f"{a_under_b / b_time:.2f}x better)"
+                if a_under_b
+                else f"{name_b} picks {b_key} ({b_time:.6g}s)"
             )
+            print(f"  {label}: DISAGREE — {name_a} picks {a_key} {priced}")
     print(f"  {disagreements} pick disagreement(s) across {len(points)} point(s)")
     return 0
 
@@ -616,6 +665,140 @@ def cmd_calibrate(args) -> int:
         print(
             f"calibrate: REGRESSION: predicted step time misses measured by "
             f"{err:.2%} (> {args.tol:.0%}) — the fit does not explain this run",
+            file=sys.stderr,
+        )
+        return 1
+    missing = [p for p in (args.require_fitted or []) if p not in prof.fitted]
+    if missing:
+        print(
+            f"calibrate: REGRESSION: required parameter(s) left at static "
+            f"constants instead of fitted: {', '.join(missing)} "
+            f"(fitted: {', '.join(prof.fitted) or 'none'}) — the run's "
+            "telemetry carried no signal for them",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+# ---------------------------------------------------------------------- #
+# profiles (cross-profile drift sentinel)
+# ---------------------------------------------------------------------- #
+
+_PROFILE_PARAMS = (
+    ("bw_dcn", "B/s"),
+    ("bw_ici", "B/s"),
+    ("t_enc_s", "s"),
+    ("t_dec_s", "s"),
+    ("compute_time_s", "s"),
+)
+
+
+def _rel_drift(values: List[float]) -> float:
+    hi, lo = max(values), min(values)
+    return (hi - lo) / hi if hi > 0 else 0.0
+
+
+def cmd_profiles(args) -> int:
+    """`profiles A.json B.json [...] --against BENCH.json`: the
+    cross-profile drift sentinel. Reports (a) per-parameter drift between
+    the saved profiles, (b) per-route row disagreement from their v2
+    route tables, and (c) — the exit-code-gated part — which committed
+    bench plan selections flip between the profiles: for every sweep
+    point of every --against record, each profile's pick is computed and
+    any point where two profiles disagree counts as a flip. Exits 1 when
+    any pick flips; parameter/route drift alone is informational."""
+    if len(args.profiles) < 2:
+        return _fail("profiles needs at least two PROFILE.json paths")
+    profs = []
+    for path in args.profiles:
+        try:
+            profs.append((path, costmodel.load_profile(path)))
+        except (OSError, ValueError) as e:
+            return _fail(f"cannot load profile {path!r}: {e}")
+    names = [name for name, _ in profs]
+    print(f"profiles: comparing {len(profs)} profile(s)")
+    for name, prof in profs:
+        print(
+            f"  {name}: sha256 {prof.content_hash()}  "
+            f"{len(prof.routes)} route row(s)  "
+            f"(fitted: {', '.join(prof.fitted) or 'none'})"
+        )
+
+    report: Dict[str, Any] = {"profiles": names, "params": {}, "routes": {},
+                              "flips": []}
+    print("  parameter drift:")
+    for attr, unit in _PROFILE_PARAMS:
+        vals = [float(getattr(prof, attr)) for _, prof in profs]
+        drift = _rel_drift(vals)
+        shown = "  ".join(f"{v:.6g}" for v in vals)
+        print(f"    {attr:>15}: {shown} {unit}  (drift {drift:.2%})")
+        report["params"][attr] = {"values": vals, "rel_drift": drift}
+
+    labels = sorted({l for _, prof in profs for l in prof.routes})
+    if labels:
+        print("  route rows (t_enc_s/t_dec_s per route):")
+    for label in labels:
+        rows = [prof.routes.get(label) for _, prof in profs]
+        cells, encs, decs = [], [], []
+        for row in rows:
+            if row is None:
+                cells.append("(absent)")
+            else:
+                cells.append(f"{row['t_enc_s']:.4g}/{row['t_dec_s']:.4g}")
+                encs.append(float(row["t_enc_s"]))
+                decs.append(float(row["t_dec_s"]))
+        missing = sum(1 for row in rows if row is None)
+        drift = max(_rel_drift(encs), _rel_drift(decs)) if len(encs) > 1 else 0.0
+        note = f"drift {drift:.2%}" if not missing else f"{missing} absent"
+        print(f"    {label:>10}: {'  '.join(cells)}  ({note})")
+        report["routes"][label] = {
+            "rows": rows, "absent": missing, "rel_drift": drift,
+        }
+
+    flips = 0
+    total_points = 0
+    for bench_path in args.against or []:
+        bench = _load_json(pathlib.Path(bench_path))
+        if not bench:
+            return _fail(f"cannot read bench record {bench_path!r}")
+        detail = bench.get("detail", {})
+        points = _profile_points(detail if isinstance(detail, dict) else {})
+        if points is None:
+            return _fail(
+                f"{bench_path!r} has no profile-repriceable sweep points "
+                "(need detail.points, or d/ratio/n_slices/per_slice in "
+                "detail)"
+            )
+        for pt in points:
+            picks = [_point_pick(pt, prof) for _, prof in profs]
+            if any(p is None for p in picks):
+                continue
+            total_points += 1
+            label = picks[0][0]
+            keys = [p[1] for p in picks]
+            if len(set(keys)) > 1:
+                flips += 1
+                shown = ", ".join(
+                    f"{n} -> {k}" for n, k in zip(names, keys)
+                )
+                print(f"  FLIP {bench_path} {label}: {shown}")
+                report["flips"].append(
+                    {"bench": bench_path, "point": label,
+                     "picks": dict(zip(names, keys))}
+                )
+            else:
+                print(f"  ok   {bench_path} {label}: all pick {keys[0]}")
+    if args.json:
+        print(json.dumps(report, indent=2))
+    print(
+        f"profiles: {flips} plan flip(s) across {total_points} bench "
+        f"point(s) from {len(args.against or [])} record(s)"
+    )
+    if flips:
+        print(
+            "profiles: REGRESSION: plan selections flip between profiles — "
+            "the machines (or the fits) disagree enough to change decisions",
             file=sys.stderr,
         )
         return 1
@@ -840,11 +1023,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "RUN_B the fixed baseline; compares cumulative wire "
                         "volume at matched (running-min) loss and exits 1 "
                         "when adaptive spent >= wire")
-    p.add_argument("--profile", default="", metavar="PROFILE.json",
+    p.add_argument("--profile", action="append", default=[],
+                   metavar="PROFILE.json",
                    help="fitted machine profile (telemetry calibrate --out); "
                         "with --against, re-prices the bench claim under the "
                         "profile and reports static-vs-calibrated pick "
-                        "disagreements (no runs needed)")
+                        "disagreements (no runs needed); pass twice to "
+                        "compare two fitted profiles' picks instead")
     p.add_argument("--include-warmup", action="store_true",
                    help="keep compile-dominated warmup step times in the "
                         "statistics (dropped by default)")
@@ -866,7 +1051,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--tol", type=float, default=0.05,
                    help="max |predicted - measured| / measured step time "
                         "before exiting 1 (default 5%%)")
+    p.add_argument("--require-fitted", action="append", default=[],
+                   metavar="PARAM",
+                   help="exit 1 unless this parameter (e.g. bw_ici) came "
+                        "out of the fit rather than the static constants; "
+                        "repeatable — the CI gate that a hierarchical run "
+                        "actually identified its ICI leg")
     p.set_defaults(fn=cmd_calibrate)
+
+    p = sub.add_parser(
+        "profiles",
+        help="cross-profile drift sentinel: parameter/route drift between "
+             "saved machine profiles and which committed bench plan "
+             "selections flip between them (exit 1 on any flip)",
+    )
+    p.add_argument("profiles", nargs="+", metavar="PROFILE.json",
+                   help="two or more saved machine profiles to compare")
+    p.add_argument("--against", action="append", default=[],
+                   metavar="BENCH.json",
+                   help="committed bench record whose sweep points are "
+                        "re-selected under every profile; repeatable — any "
+                        "point whose pick differs between profiles exits 1")
+    p.add_argument("--json", action="store_true",
+                   help="also print the machine-readable drift report")
+    p.set_defaults(fn=cmd_profiles)
 
     p = sub.add_parser("trace", help="merged Chrome trace JSON (Perfetto)")
     p.add_argument("run")

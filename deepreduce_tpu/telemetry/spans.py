@@ -46,11 +46,12 @@ except AttributeError:  # pragma: no cover - version drift guard
 class _Span:
     """One live span: wall clock + named_scope + profiler annotation."""
 
-    __slots__ = ("_tracer", "name", "_t0", "_stack")
+    __slots__ = ("_tracer", "name", "route", "_t0", "_stack")
 
-    def __init__(self, tracer: "Tracer", name: str):
+    def __init__(self, tracer: "Tracer", name: str, route: Optional[str] = None):
         self._tracer = tracer
         self.name = name
+        self.route = route
 
     def __enter__(self) -> "_Span":
         self._stack = ExitStack()
@@ -67,7 +68,7 @@ class _Span:
         try:
             self._stack.close()
         finally:
-            self._tracer._record(self.name, self._t0, elapsed)
+            self._tracer._record(self.name, self._t0, elapsed, route=self.route)
         return False
 
 
@@ -103,10 +104,10 @@ class Tracer:
 
     # ------------------------------------------------------------------ #
 
-    def span(self, name: str):
+    def span(self, name: str, route: Optional[str] = None):
         if not self.enabled:
             return _NULL_SPAN
-        return _Span(self, name)
+        return _Span(self, name, route)
 
     def counter(self, name: str, values: Dict[str, float], ts: Optional[float] = None) -> None:
         """Record a Chrome "C" counter sample (e.g. per-step rel_volume)."""
@@ -124,7 +125,9 @@ class Tracer:
         with self._lock:
             self.events.append(ev)
 
-    def _record(self, name: str, t0: float, elapsed: float) -> None:
+    def _record(
+        self, name: str, t0: float, elapsed: float, route: Optional[str] = None
+    ) -> None:
         ev = {
             "name": name,
             "cat": "telemetry",
@@ -134,6 +137,12 @@ class Tracer:
             "pid": self._pid,
             "tid": threading.get_ident(),
         }
+        if route is not None:
+            # route/codec attribution: lands in the Chrome event's args so
+            # calibrate() can bucket encode/decode self-time per route. The
+            # span NAME stays route-free — named_scope labels (and therefore
+            # telemetry-on HLO) are identical with or without attribution.
+            ev["args"] = {"route": str(route)}
         with self._lock:
             self.events.append(ev)
 
@@ -181,9 +190,12 @@ def configure(*, enabled: Optional[bool] = None, reset: bool = False) -> Tracer:
     return _tracer
 
 
-def span(name: str):
-    """`with span("exchange/encode"): ...` — records wall time + labels the
-    XLA profile when telemetry is on; a shared inert no-op when off."""
+def span(name: str, route: Optional[str] = None):
+    """`with span("exchange/encode", route="quantized"): ...` — records wall
+    time + labels the XLA profile when telemetry is on; a shared inert no-op
+    when off. ``route`` attributes the span to the active exchange route /
+    codec (it lands in the trace event's args, never in the scope name), so
+    calibrate() can fit per-route encode/decode rows."""
     if not _tracer.enabled:
         return _NULL_SPAN
-    return _Span(_tracer, name)
+    return _Span(_tracer, name, route)

@@ -98,16 +98,17 @@ def test_golden_fit_include_warmup_escape_hatch():
 
 
 def _plant_run(tmp_path, *, workers=4, dcn_bytes=3000.0):
-    """Three identical 10ms steps, each decomposing as 3ms encode + 1ms
-    DCN wire + 6ms forward_backward (children nested inside train/step, so
-    the self-time stack must not double-charge the container)."""
+    """Five identical 10ms steps (the fit refuses runs shorter than 4
+    post-warmup samples), each decomposing as 3ms encode + 1ms DCN wire +
+    6ms forward_backward (children nested inside train/step, so the
+    self-time stack must not double-charge the container)."""
     run = tmp_path / "planted"
     run.mkdir()
     (run / "config.json").write_text(
         json.dumps({"config": {"workers": workers}})
     )
     events = []
-    for i in range(3):
+    for i in range(5):
         t0 = i * 20_000
         events += [
             {"ph": "X", "pid": 1, "tid": 1, "name": "train/step",
@@ -140,6 +141,81 @@ def test_synthetic_planted_parameters_are_recovered(tmp_path):
     assert prof.source["measured_step_s"] == pytest.approx(0.01)
 
 
+def _plant_routed_run(tmp_path, *, workers=4, dcn_bytes=3000.0):
+    """Five identical 10ms steps with ROUTE-LABELED codec spans: per step
+    2ms encode on route 'sparse', 1ms encode + 2ms decode on route
+    'fused', 1ms DCN wire, 4ms forward_backward. The route label rides in
+    the event's args (the span name stays route-free), exactly as the
+    exchangers emit it."""
+    run = tmp_path / "routed"
+    run.mkdir()
+    (run / "config.json").write_text(
+        json.dumps({"config": {"workers": workers}})
+    )
+    events = []
+    for i in range(5):
+        t0 = i * 20_000
+        events += [
+            {"ph": "X", "pid": 1, "tid": 1, "name": "train/step",
+             "ts": t0, "dur": 10_000},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "exchange/encode",
+             "ts": t0, "dur": 2_000, "args": {"route": "sparse"}},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "exchange/encode",
+             "ts": t0 + 2_000, "dur": 1_000, "args": {"route": "fused"}},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "exchange/decode",
+             "ts": t0 + 3_000, "dur": 2_000, "args": {"route": "fused"}},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "exchange/allgather",
+             "ts": t0 + 5_000, "dur": 1_000},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "train/forward_backward",
+             "ts": t0 + 6_000, "dur": 4_000},
+        ]
+    (run / "trace.json").write_text(json.dumps({"traceEvents": events}))
+    (run / "summary.json").write_text(
+        json.dumps({"telemetry": {"dcn_bytes_per_step": dcn_bytes}})
+    )
+    return run
+
+
+def test_synthetic_two_route_rows_are_recovered(tmp_path):
+    """The v2 tentpole: planted per-route encode/decode seconds come back
+    as `routes` rows within 5%, on top of the unchanged global fit."""
+    run = _plant_routed_run(tmp_path)
+    prof = costmodel.calibrate(run)
+    costmodel.validate_profile(prof.to_record())
+    assert set(prof.routes) == {"sparse", "fused"}
+    tol = dict(rel=0.05)
+    # route 'sparse': encode-only codec, no decode row contribution
+    assert prof.routes["sparse"]["t_enc_s"] == pytest.approx(0.002, **tol)
+    assert prof.routes["sparse"]["t_dec_s"] == 0.0
+    # route 'fused': gather-side decode pays W decodes/step, so the row
+    # holds the per-decode cost (2ms / W=4)
+    assert prof.routes["fused"]["t_enc_s"] == pytest.approx(0.001, **tol)
+    assert prof.routes["fused"]["t_dec_s"] == pytest.approx(0.0005, **tol)
+    assert prof.routes["sparse"]["samples"] == 5
+    assert prof.routes["fused"]["samples"] == 10
+    # the global fit is the sum over routes (same decomposition as before)
+    assert prof.t_enc_s == pytest.approx(0.003, **tol)
+    assert prof.t_dec_s == pytest.approx(0.0005, **tol)
+    assert prof.bw_dcn == pytest.approx(9.0e6, **tol)
+    # consumption plumbing: a row converts to the measurements spelling
+    m = costmodel.route_measurement(prof, "sparse")
+    assert m == {
+        "t_encode_s": prof.routes["sparse"]["t_enc_s"],
+        "t_decode_s": prof.routes["sparse"]["t_dec_s"],
+    }
+    assert costmodel.route_measurement(prof, "no-such-route") is None
+
+
+def test_route_rows_survive_save_load_round_trip(tmp_path):
+    prof = costmodel.calibrate(_plant_routed_run(tmp_path))
+    path = tmp_path / "routed_profile.json"
+    prof.save(path)
+    again = costmodel.load_profile(path)
+    assert again == prof
+    assert again.routes == prof.routes
+    assert again.content_hash() == prof.content_hash()
+
+
 def test_calibrate_raises_on_non_run_dirs(tmp_path):
     with pytest.raises(ValueError, match="config.json"):
         costmodel.calibrate(tmp_path)
@@ -147,6 +223,28 @@ def test_calibrate_raises_on_non_run_dirs(tmp_path):
     run.mkdir()
     (run / "config.json").write_text(json.dumps({"config": {"workers": 2}}))
     with pytest.raises(ValueError, match="telemetry"):
+        costmodel.calibrate(run)
+
+
+def test_calibrate_refuses_short_runs_naming_the_length(tmp_path):
+    """A 3-step run leaves < 4 post-warmup samples — the fit must refuse
+    with the run length in the message instead of emitting a profile built
+    on noise."""
+    run = tmp_path / "short"
+    run.mkdir()
+    (run / "config.json").write_text(json.dumps({"config": {"workers": 4}}))
+    events = []
+    for i in range(3):
+        t0 = i * 20_000
+        events += [
+            {"ph": "X", "pid": 1, "tid": 1, "name": "train/step",
+             "ts": t0, "dur": 10_000},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "train/forward_backward",
+             "ts": t0, "dur": 10_000},
+        ]
+    (run / "trace.json").write_text(json.dumps({"traceEvents": events}))
+    (run / "summary.json").write_text(json.dumps({"telemetry": {}}))
+    with pytest.raises(ValueError, match=r"3 sample\(s\).*>= 4 post-warmup"):
         costmodel.calibrate(run)
 
 
@@ -194,6 +292,66 @@ def test_profile_schema_rejections(mutate, match):
 def test_validate_rejects_non_dict():
     with pytest.raises(ValueError, match="dict"):
         costmodel.validate_profile([1, 2, 3])
+
+
+def test_v1_record_loads_with_empty_routes_and_identical_selection():
+    """Back-compat: a v1 record (no routes table) must load cleanly with
+    routes={}, and every selector output under the loaded profile must be
+    byte-identical to the v2-with-empty-routes profile it came from —
+    committed records like BENCH_CALIB_r16 keep replaying unchanged."""
+    prof = costmodel.calibrate(GOLDEN)
+    assert prof.routes == {}
+    rec_v1 = prof.to_record()
+    rec_v1["schema"] = costmodel.PROFILE_SCHEMA_V1
+    del rec_v1["routes"]
+    again = costmodel.MachineProfile.from_record(rec_v1)
+    assert again.routes == {}
+    assert again == prof
+    for ratio in (0.001, 0.01, 0.1):
+        a = costmodel.select_hier_plan(LSTM_D, 2, 16, ratio, profile=prof)
+        b = costmodel.select_hier_plan(LSTM_D, 2, 16, ratio, profile=again)
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+        assert costmodel.select_rs_mode(
+            LSTM_D, 8, ratio, profile=prof
+        ) == costmodel.select_rs_mode(LSTM_D, 8, ratio, profile=again)
+    # loads from disk too: BENCH_CALIB_r16's embedded record is v1-era
+    embedded = json.load(open(REPO / "BENCH_CALIB_r16.json"))
+    costmodel.MachineProfile.from_record(embedded["detail"]["profile"])
+
+
+def test_v1_record_with_routes_table_is_rejected():
+    rec = costmodel.calibrate(GOLDEN).to_record()
+    rec["schema"] = costmodel.PROFILE_SCHEMA_V1
+    rec["routes"] = {"fused": {"t_enc_s": 0.1, "t_dec_s": 0.0, "samples": 1}}
+    with pytest.raises(ValueError, match="v1 profile records carry no"):
+        costmodel.validate_profile(rec)
+
+
+_GOOD_ROW = {"t_enc_s": 0.001, "t_dec_s": 0.0005, "samples": 4}
+
+
+@pytest.mark.parametrize(
+    "routes, match",
+    [
+        (["fused"], "'routes' must be a dict"),
+        ({"": dict(_GOOD_ROW)}, "non-empty string"),
+        ({"fused": [0.1, 0.2]}, "must be a dict"),
+        ({"fused": {**_GOOD_ROW, "extra": 1.0}}, "unknown keys"),
+        ({"fused": {"t_enc_s": 0.1}}, "unknown keys|must be a number"),
+        ({"fused": {**_GOOD_ROW, "t_enc_s": -0.1}}, "finite and\\s+>= 0"),
+        ({"fused": {**_GOOD_ROW, "t_dec_s": float("nan")}}, "finite"),
+        ({"fused": {**_GOOD_ROW, "t_enc_s": "fast"}}, "must be a number"),
+        ({"fused": {**_GOOD_ROW, "t_enc_s": True}}, "must be a number"),
+        ({"fused": {**_GOOD_ROW, "samples": 0}}, "positive"),
+        ({"fused": {**_GOOD_ROW, "samples": 2.5}}, "positive"),
+        ({"fused": {**_GOOD_ROW, "samples": True}}, "positive"),
+    ],
+)
+def test_malformed_route_rows_are_rejected(routes, match):
+    rec = costmodel.calibrate(GOLDEN).to_record()
+    rec["routes"] = routes
+    with pytest.raises(ValueError, match=match):
+        costmodel.validate_profile(rec)
 
 
 # --------------------------------------------------------------------- #

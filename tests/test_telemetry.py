@@ -63,6 +63,25 @@ def test_tracer_emits_valid_chrome_trace(tmp_path):
     assert json.loads(path.read_text())["traceEvents"]
 
 
+def test_span_route_label_rides_in_args_not_the_name():
+    """Route attribution contract: the label lands in the Chrome event's
+    args (where `calibrate` buckets per-route rows) while the span NAME is
+    untouched — the name is what named_scope mirrors into HLO, so labeling
+    must never move a compiled program."""
+    tr = Tracer(enabled=True)
+    with tr.span("exchange/encode", route="oktopk"):
+        pass
+    with tr.span("exchange/encode"):
+        pass
+    labeled, bare = tr.events
+    assert labeled["name"] == bare["name"] == "exchange/encode"
+    assert labeled["args"] == {"route": "oktopk"}
+    assert "args" not in bare
+    # disabled tracers hand back the same inert object regardless of route
+    off = Tracer(enabled=False)
+    assert off.span("x", route="r") is off.span("y")
+
+
 def test_disabled_span_is_shared_noop():
     tr = Tracer(enabled=False)
     a, b = tr.span("x"), tr.span("y")
@@ -250,7 +269,9 @@ def test_telemetry_off_jaxpr_identical_to_absent(monkeypatch):
     with real (disabled) spans hashes identically to one where every span
     call is replaced by a bare nullcontext — i.e. disabled == absent."""
     h_disabled = _step_jaxpr_hash()
-    monkeypatch.setattr(spans, "span", lambda name: contextlib.nullcontext())
+    monkeypatch.setattr(
+        spans, "span", lambda name, route=None: contextlib.nullcontext()
+    )
     h_absent = _step_jaxpr_hash()
     assert h_disabled == h_absent
 
@@ -324,6 +345,43 @@ def test_cli_compare_against_bench(tmp_path, capsys):
     other = _write_run(tmp_path, "other", dt=0.05,
                        config={"decode_strategy": "vmap"})
     assert cli.main(["compare", str(other), "--against", str(bench)]) == 2
+
+
+def test_cli_profiles_drift_sentinel(tmp_path, capsys):
+    """`telemetry profiles`: identical profiles never flip a committed plan
+    selection (exit 0); the fitted TRACE_OVERLAP_r15 golden profile vs the
+    static constants is a known planted drift that flips BENCH_CALIB_r16's
+    small-slice hier picks (exit 1)."""
+    import pathlib
+
+    from deepreduce_tpu import costmodel
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    g = tmp_path / "golden.json"
+    costmodel.calibrate(repo / "TRACE_OVERLAP_r15").save(g)
+    s = tmp_path / "static.json"
+    costmodel.static_profile().save(s)
+    bench = repo / "BENCH_CALIB_r16.json"
+
+    assert cli.main(["profiles", str(g), str(g), "--against", str(bench)]) == 0
+    out = capsys.readouterr().out
+    assert "0 plan flip(s)" in out and "parameter drift" in out
+
+    assert cli.main(["profiles", str(g), str(s), "--against", str(bench)]) == 1
+    cap = capsys.readouterr()
+    assert "FLIP" in cap.out
+    assert "REGRESSION" in cap.err
+
+    # without --against the sentinel still reports drift, exit 0 (no picks)
+    assert cli.main(["profiles", str(g), str(s)]) == 0
+    capsys.readouterr()
+
+    # usage/data errors: one profile, unreadable path, pointless bench
+    assert cli.main(["profiles", str(g)]) == 2
+    assert cli.main(["profiles", str(g), str(tmp_path / "nope.json")]) == 2
+    bad = tmp_path / "bad_bench.json"
+    bad.write_text(json.dumps({"detail": {"nothing": True}}))
+    assert cli.main(["profiles", str(g), str(s), "--against", str(bad)]) == 2
 
 
 def test_cli_trace_merges_spans_and_counters(tmp_path, capsys):

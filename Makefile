@@ -1,4 +1,4 @@
-.PHONY: analyze analyze-quick matrix-check test test-quick telemetry-check chaos-check fedsim-check ctrl-check overlap-check calibrate-check
+.PHONY: analyze analyze-quick matrix-check test test-quick telemetry-check chaos-check fedsim-check fedasync-check ctrl-check overlap-check calibrate-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
@@ -7,7 +7,7 @@
 # (chaos-check), the federated round smoke (fedsim-check) and the
 # composition-lattice legality matrix (matrix-check) so none of those
 # paths can rot while the gate stays green.
-analyze: matrix-check telemetry-check chaos-check fedsim-check ctrl-check overlap-check calibrate-check
+analyze: matrix-check telemetry-check chaos-check fedsim-check fedasync-check ctrl-check overlap-check calibrate-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
 
 # composition-lattice legality gate: probe the full feature cross-product
@@ -40,6 +40,20 @@ fedsim-check:
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.fedsim --platform cpu check \
 		--track_dir $(FEDSIM_CHECK_DIR)
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry summary $(FEDSIM_CHECK_DIR)/check
+
+# asynchronous-federated smoke: a short buffered-ingest run on the same
+# 8-device CPU mesh (staleness-weighted deltas, K-threshold applies,
+# 3-level latency distribution, churn + wire corruption) — asserts
+# staleness was observed, the buffer applied, and a MID-BUFFER checkpoint
+# (partially filled, staleness counters nonzero) resumes BITWISE; then the
+# telemetry CLI digests the staleness rows (fed_staleness_mean/max,
+# fed_buffer_fill_per_apply).
+FEDASYNC_CHECK_DIR := /tmp/drtpu_fedasync_check
+fedasync-check:
+	rm -rf $(FEDASYNC_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.fedsim --platform cpu check \
+		--async --rounds 8 --track_dir $(FEDASYNC_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry summary $(FEDASYNC_CHECK_DIR)/check
 
 # resilience smoke: a short 8-worker CPU-mesh train under a FaultPlan drop
 # schedule + wire corruption with payload checksums — asserts finite,

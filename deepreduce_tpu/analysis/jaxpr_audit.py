@@ -588,6 +588,87 @@ def audit_fedsim_round(*, d: int = 512) -> List[TraceRecord]:
     return [trace_and_check("fedsim:round", fn, args, ctx, payload_bytes=pb)]
 
 
+def audit_fedsim_async_round(*, d: int = 512) -> List[TraceRecord]:
+    """The asynchronous buffered tick keeps the round's collective contract:
+    however deep the overlap ring, however the K-threshold buffered apply
+    gates the server update, the whole ingest tick is still exactly ONE
+    fused psum — the sync tuple plus the staleness-weight mass, so the
+    operand bytes are exactly 4*(param_elements + 7) B/worker. Codec count
+    stays at TWO (pending-gated S2C delta encode is staged exactly once;
+    the vmapped C2S client encode is shared by the cohort); the latency
+    draw and buffered apply add no collectives because staleness is drawn
+    replicated over global cohort positions from the shared tick key."""
+    import optax
+
+    from deepreduce_tpu.fedsim.sim import (
+        AsyncBuffer,
+        FedSim,
+        synthetic_linear_problem,
+    )
+
+    tmap = jax.tree_util.tree_map
+    cfg = DeepReduceConfig(
+        memory="residual",
+        fed=True,
+        fed_num_clients=64,
+        fed_clients_per_round=16,
+        fed_local_steps=2,
+        fed_async=True,
+        fed_async_k=40,
+        fed_async_alpha=0.5,
+        fed_async_latency="0.5,0.3,0.2",
+        **_FLAGSHIP,
+    )
+    fed = cfg.fed_config()
+    params0, data_fn, loss_fn = synthetic_linear_problem(d, 4, fed.local_steps)
+    fs = FedSim(
+        loss_fn, cfg, fed, optax.sgd(0.1), data_fn, mesh=audit_mesh(), axis=AXIS
+    )
+    fn = fs.sharded_round_fn()
+    params_sds = tmap(lambda p: _sds(p.shape, p.dtype), params0)
+    bank_sds = tmap(
+        lambda p: _sds((fed.num_clients,) + p.shape, p.dtype), params_sds
+    )
+    D = len(fs.latency_probs)
+    buf_sds = AsyncBuffer(
+        delta_sum=params_sds,
+        weight=_sds((), jnp.float32),
+        count=_sds((), jnp.float32),
+        k=_sds((), jnp.float32),
+        version=_sds((), jnp.int32),
+        hist=tmap(lambda p: _sds((D,) + p.shape, p.dtype), params_sds),
+        stale_sum=_sds((), jnp.float32),
+        stale_max=_sds((), jnp.float32),
+        pending=_sds((), jnp.float32),
+    )
+    n_elems = sum(
+        int(jnp.prod(jnp.array(p.shape))) if p.shape else 1
+        for p in jax.tree_util.tree_leaves(params_sds)
+    )
+    # psum tuple = param-leaf update sums + wire4 + nlive + nfail + wsum
+    pb = 4 * (n_elems + 7)
+    args = (
+        params_sds,  # params (replicated)
+        params_sds,  # w_ref (replicated)
+        bank_sds,  # residual bank, P(axis) on dim 0
+        None,  # telemetry accumulators (off)
+        _STEP,  # tick counter
+        _sds((2,), jnp.uint32),  # tick key
+        buf_sds,  # aggregation buffer + w_hist ring (replicated)
+    )
+    ctx = AuditContext(
+        label="fedsim:async-round",
+        allow_callbacks=False,
+        expect_collectives={"psum": 1},
+        wire_mode="collective",
+        expected_wire_bytes=pb,
+        num_workers=NUM_WORKERS,
+        expect_codec_invocations=2,
+        require_key_lineage=True,
+    )
+    return [trace_and_check("fedsim:async-round", fn, args, ctx, payload_bytes=pb)]
+
+
 def _per_tensor_expected_gathers(cfg: DeepReduceConfig, d: int) -> int:
     """fused=False issues one all_gather per payload *leaf* (all_gather maps
     over the pytree) — the static count is the leaf count."""
@@ -1280,6 +1361,11 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
             wire_mode="collective",
         ),
     )
+    # --- the r20 asynchronous buffered tick: same one-psum contract with
+    # the staleness-weight mass riding the fused tuple (registered last so
+    # the pre-existing record order — and ANALYSIS.json hashes — are
+    # stable) ---
+    add("fedsim:async-round", lambda: audit_fedsim_async_round())
     return specs
 
 

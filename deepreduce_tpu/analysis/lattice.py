@@ -48,7 +48,11 @@ SCHEMA = "deepreduce_tpu/analysis-report/v1"
 
 # (axis name, value labels) in lexicographic cell order. Every label maps
 # to concrete config kwargs in `cell_kwargs`; the cross-product is the
-# probed lattice (4*3*2*2*6*4*2*2*2 = 9216 cells).
+# probed lattice (4*3*2*2*6*4*2*2*2*2 = 18432 cells). New axes are
+# appended LAST: product order then expands every pre-existing cell into
+# an adjacent (off, on) pair with the off plane first, so the old lattice
+# survives as the fed_async=off plane and re-baselining can be diffed
+# cell-by-cell.
 AXES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("communicator", ("allgather", "allreduce", "qar", "sparse_rs")),
     ("decode", ("loop", "vmap", "ring")),
@@ -59,6 +63,7 @@ AXES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("resilience", ("off", "on")),
     ("ctrl", ("off", "on")),
     ("fed", ("off", "on")),
+    ("fed_async", ("off", "on")),
 )
 
 # ctrl + telemetry are host-side only (the audited jx-ctrl-ladder
@@ -134,6 +139,13 @@ def cell_kwargs(cell: Dict[str, str]) -> Dict[str, Any]:
         kw.update(
             fed=True, fed_num_clients=64, fed_clients_per_round=16,
             fed_local_steps=2,
+        )
+    if cell["fed_async"] == "on":
+        # without fed=on this cell is ILLEGAL by construction
+        # (fed-async-needs-fed) — the probe measures exactly that
+        kw.update(
+            fed_async=True, fed_async_k=8, fed_async_alpha=0.5,
+            fed_async_latency="0.6,0.3,0.1",
         )
     return kw
 
@@ -252,14 +264,20 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
     """The federated round harness, parametrized over cfg (the fixed audit
     hardcodes the flagship config): one jitted shard_map round over the
     client-sharded residual bank, wire accounting pinned to the single
-    fused psum's 4*(param_elements + 6) B/worker."""
+    fused psum's 4*(param_elements + 6) B/worker — or, on the fed_async=on
+    plane, the buffered ingest tick's 4*(param_elements + 7) (the
+    staleness-weight mass rides the same fused tuple)."""
     import jax
     import jax.numpy as jnp
     import optax
 
     from deepreduce_tpu.analysis import jaxpr_audit as ja
     from deepreduce_tpu.analysis.rules import AuditContext
-    from deepreduce_tpu.fedsim.sim import FedSim, synthetic_linear_problem
+    from deepreduce_tpu.fedsim.sim import (
+        AsyncBuffer,
+        FedSim,
+        synthetic_linear_problem,
+    )
 
     tmap = jax.tree_util.tree_map
     fed = cfg.fed_config()
@@ -279,7 +297,7 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
         int(jnp.prod(jnp.array(p.shape))) if p.shape else 1
         for p in jax.tree_util.tree_leaves(params_sds)
     )
-    pb = 4 * (n_elems + 6)
+    pb = 4 * (n_elems + 6 + (1 if cfg.fed_async else 0))
     args = (
         params_sds,
         params_sds,
@@ -288,6 +306,26 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
         ja._STEP,
         ja._sds((2,), jnp.uint32),
     )
+    if cfg.fed_async:
+        D = len(fs.latency_probs)
+        sc = lambda dt=jnp.float32: ja._sds((), dt)
+        args = args + (
+            AsyncBuffer(
+                delta_sum=params_sds,
+                weight=sc(),
+                count=sc(),
+                k=sc(),
+                version=sc(jnp.int32),
+                hist=(
+                    tmap(lambda p: ja._sds((D,) + p.shape, p.dtype), params_sds)
+                    if D > 1
+                    else None
+                ),
+                stale_sum=sc(),
+                stale_max=sc(),
+                pending=sc(),
+            ),
+        )
     ctx = AuditContext(
         label=label,
         wire_mode="collective",

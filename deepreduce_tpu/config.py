@@ -92,6 +92,12 @@ REASON_CODES: Dict[str, str] = {
     "fed-vs-buckets": "the fed round's TreeCodec path ignores bucket_bytes",
     "fed-vs-decode-strategy":
         "the fed round has no gathered-worker decode to restructure",
+    "fed-vs-trainer": "Trainer runs the data-parallel exchange, not fed rounds",
+    "fed-async-needs-fed": "fed_async=True without the fed round geometry",
+    "fed-async-knobs-disengaged": "fed_async_* knob(s) without fed_async=True",
+    "fed-async-k-range": "fed_async_k < 1 with fed_async=True",
+    "fed-async-alpha-range": "fed_async_alpha < 0",
+    "fed-async-latency-syntax": "fed_async_latency failed parse_latency",
     "ctrl-knobs-disengaged": "ctrl_* knob(s) without ctrl=True",
     "ctrl-needs-telemetry": "ctrl=True without telemetry=True",
     "ctrl-needs-compressor": "ctrl=True with compressor='none'",
@@ -395,6 +401,31 @@ class DeepReduceConfig:
     # this many vmapped clients per worker instead of one [C_local, ...]
     # batch (must divide the per-worker cohort). 0 = single vmap block.
     fed_client_chunk: int = 0
+    # asynchronous buffered aggregation (FedBuff-style): the jitted round
+    # becomes an ingest *tick* that accumulates staleness-weighted client
+    # deltas into a server-side buffer carried across steps, applying a
+    # buffered update whenever fed_async_k contributions have arrived.
+    # Off by default: fed_async=False leaves the synchronous round program
+    # byte-identical to the pre-async driver (pinned by the fedsim:round
+    # audit spec).
+    fed_async: bool = False
+    # apply threshold K: the server applies the buffered update once the
+    # buffer holds >= K live contributions (K may exceed the per-tick
+    # cohort — the buffer then fills across ticks). Required >= 1 when
+    # fed_async=True.
+    fed_async_k: int = 0
+    # staleness exponent alpha: a contribution trained from the model as of
+    # tau server versions ago is down-weighted by 1/(1+tau)^alpha. 0.0 is
+    # identity weighting (every live contribution weighs 1.0 — the
+    # degenerate case that is bitwise-equal to the synchronous round when
+    # K == cohort and the latency distribution is zero).
+    fed_async_alpha: float = 0.0
+    # per-client latency distribution over staleness tau = 0, 1, 2, ...:
+    # comma-separated non-negative weights, e.g. "0.6,0.3,0.1" (normalized
+    # at parse). Drawn deterministically per (round key, cohort position)
+    # like FaultPlan churn, so every worker agrees without a collective.
+    # "" = zero latency (every client trains from the current model).
+    fed_async_latency: str = ""
     # adaptive compression controller (deepreduce_tpu.controller): every
     # `telemetry_every` steps the Trainer feeds the fetched
     # MetricAccumulators window delta to a host-side controller that moves
@@ -872,6 +903,52 @@ class DeepReduceConfig:
                     "and would silently ignore it — keep the default 'loop' "
                     "with fed=True"
                 )
+        # --- asynchronous buffered aggregation (fedsim async mode) ---
+        fed_async_engaged = [
+            name
+            for name, default in (
+                ("fed_async_k", 0),
+                ("fed_async_alpha", 0.0),
+                ("fed_async_latency", ""),
+            )
+            if getattr(self, name) != default
+        ]
+        if fed_async_engaged and not self.fed_async:
+            raise ConfigError(
+                "fed-async-knobs-disengaged",
+                f"{', '.join(fed_async_engaged)} configure the asynchronous "
+                "buffered aggregation and would be silently ignored with "
+                "fed_async=False — set fed_async=True (or drop the knob(s))"
+            )
+        if self.fed_async:
+            if not self.fed:
+                raise ConfigError(
+                    "fed-async-needs-fed",
+                    "fed_async=True buffers the federated round's client "
+                    "deltas across ingest ticks — there is no round to "
+                    "buffer without fed=True (set the fed_* geometry too)"
+                )
+            if self.fed_async_k < 1:
+                raise ConfigError(
+                    "fed-async-k-range",
+                    "fed_async=True requires a positive apply threshold "
+                    f"fed_async_k, got {self.fed_async_k}"
+                )
+            if self.fed_async_alpha < 0:
+                raise ConfigError(
+                    "fed-async-alpha-range",
+                    "fed_async_alpha is a down-weighting exponent "
+                    f"1/(1+tau)^alpha and must be >= 0, got "
+                    f"{self.fed_async_alpha}"
+                )
+            # syntax check at construction (deferred import: round.py's
+            # parser is config-free at parse time — mirrors FaultPlan.parse)
+            from deepreduce_tpu.fedsim.round import parse_latency
+
+            try:
+                parse_latency(self.fed_async_latency)
+            except ValueError as e:
+                raise ConfigError("fed-async-latency-syntax", str(e)) from e
         # --- adaptive controller: loud failure for silently-ignored knobs ---
         ctrl_engaged = [
             name

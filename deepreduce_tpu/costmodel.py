@@ -456,6 +456,80 @@ def fed_clients_per_sec(
     return clients / max(t, 1e-12)
 
 
+def expected_staleness(latency_probs=(1.0,)) -> float:
+    """Mean staleness E[tau] of a latency distribution (unnormalized
+    weights over tau = 0, 1, 2, ... — same spec `parse_latency` accepts)."""
+    total = sum(latency_probs)
+    if total <= 0:
+        return 0.0
+    return sum(i * p for i, p in enumerate(latency_probs)) / total
+
+
+def fed_async_apply_time(
+    uplink_bytes_per_client: float,
+    k: int,
+    bw: float = BW_100MBPS,
+    *,
+    t_client_s: float = 0.0,
+    downlink_bytes: float = 0.0,
+    server_links: int = 1,
+    overlap_depth: int = 1,
+    latency_probs=(1.0,),
+) -> float:
+    """Wall seconds between buffered server applies in the asynchronous
+    (FedBuff-style) mode. Two pipelined limits, the slower of which gates
+    the apply cadence:
+
+    - *ingest*: K live uplinks (plus the one S2C broadcast of the apply)
+      must cross the server link(s) — identical shape to the synchronous
+      wire term, but sized by the buffer threshold K instead of the cohort.
+    - *compute*: clients of up to `overlap_depth` in-flight cohorts train
+      concurrently against ring versions of the model, so the K-th delta
+      arrives after one client latency *stretched by the mean staleness*
+      (a tau-stale cohort started tau applies ago) and *divided by the
+      overlap depth* (deeper overlap keeps more deltas perpetually in
+      flight — the whole point of leaving rounds for a stream).
+
+    Unlike `fed_round_time`, the client latency is NOT additive with the
+    wire: overlapped cohorts hide compute behind ingest, which is exactly
+    why the async apply time can beat the synchronous round at equal K."""
+    wire = (k * uplink_bytes_per_client + downlink_bytes) / (
+        bw * max(server_links, 1)
+    )
+    depth = max(int(overlap_depth), 1)
+    compute = t_client_s * (1.0 + expected_staleness(latency_probs)) / depth
+    return max(wire, compute)
+
+
+def fed_async_clients_per_sec(
+    uplink_bytes_per_client: float,
+    k: int,
+    bw: float = BW_100MBPS,
+    *,
+    t_client_s: float = 0.0,
+    downlink_bytes: float = 0.0,
+    server_links: int = 1,
+    overlap_depth: int = 1,
+    latency_probs=(1.0,),
+) -> float:
+    """Served clients per second of the buffered async stream: K
+    contributions are absorbed per apply period. With zero client latency
+    this upper-bounds `fed_clients_per_sec` (the sync round pays the same
+    wire per client, serialized behind the cohort barrier); with a real
+    latency distribution the gap is the hidden `t_client_s` term."""
+    t = fed_async_apply_time(
+        uplink_bytes_per_client,
+        k,
+        bw,
+        t_client_s=t_client_s,
+        downlink_bytes=downlink_bytes,
+        server_links=server_links,
+        overlap_depth=overlap_depth,
+        latency_probs=latency_probs,
+    )
+    return k / max(t, 1e-12)
+
+
 # ---------------------------------------------------------------------------
 # Per-rs_mode static wire accounting. These return the per-worker
 # *injection* bytes of every collective the route issues — the same

@@ -10,16 +10,30 @@ Import surface:
 """
 
 from deepreduce_tpu.fedsim.codec_tree import TreeCodec, TreeSpec
-from deepreduce_tpu.fedsim.round import FedConfig, cohort_updates, make_client_step
-from deepreduce_tpu.fedsim.sim import FedSim, FedSimState, synthetic_linear_problem
+from deepreduce_tpu.fedsim.round import (
+    FedConfig,
+    cohort_updates,
+    make_async_client_step,
+    make_client_step,
+    parse_latency,
+)
+from deepreduce_tpu.fedsim.sim import (
+    AsyncBuffer,
+    FedSim,
+    FedSimState,
+    synthetic_linear_problem,
+)
 
 __all__ = [
+    "AsyncBuffer",
     "FedConfig",
     "FedSim",
     "FedSimState",
     "TreeCodec",
     "TreeSpec",
     "cohort_updates",
+    "make_async_client_step",
     "make_client_step",
+    "parse_latency",
     "synthetic_linear_problem",
 ]

@@ -55,7 +55,7 @@ def _run_check(args):
     from deepreduce_tpu.fedsim.round import FedConfig
     from deepreduce_tpu.fedsim.sim import FedSim, synthetic_linear_problem
 
-    cfg = _build_cfg(
+    overrides = dict(
         fed=True,
         fed_num_clients=args.num_clients,
         fed_clients_per_round=args.clients_per_round,
@@ -66,6 +66,17 @@ def _run_check(args):
         payload_checksum=True,
         chaos_corrupt_rate=0.2,
     )
+    if args.use_async:
+        # buffered async tick: K > 2 cohorts so the buffer fills across
+        # ticks (the mid-run checkpoint lands mid-buffer), a 3-level
+        # latency distribution so staleness counters are nonzero
+        overrides.update(
+            fed_async=True,
+            fed_async_k=int(2.2 * args.clients_per_round),
+            fed_async_alpha=0.5,
+            fed_async_latency="0.5,0.3,0.2",
+        )
+    cfg = _build_cfg(**overrides)
     fed = cfg.fed_config()
     dim, batch = 32, 8
     params0, data_fn, loss_fn = synthetic_linear_problem(dim, batch, fed.local_steps)
@@ -90,13 +101,35 @@ def _run_check(args):
     rounds_hist = []
     ckpt_path = f"{args.track_dir}/ckpt"
     mid = args.rounds // 2
+    save_at = None
+    saved_buffer_fill = None
+    saved_stale_sum = None
     for r in range(args.rounds):
         state, m = fs.step(state, jax.random.fold_in(key, r))
         rec = {k: float(v) for k, v in m.items()}
         rounds_hist.append(rec)
         run.log({"round": r, **rec})
-        if r + 1 == mid:
+        if args.use_async:
+            # save at the first mid-run tick where the buffer is MID-FILL
+            # (partially filled, staleness counters nonzero) — the apply
+            # cadence floats with churn, so a fixed tick could land right
+            # on an apply's reset and checkpoint an empty buffer
+            want_save = (
+                save_at is None
+                and r + 1 >= mid
+                and float(state.buffer.count) > 0
+                and float(state.buffer.stale_sum) > 0
+            )
+        else:
+            want_save = r + 1 == mid
+        if want_save:
+            save_at = r + 1
+            if state.buffer is not None:
+                saved_buffer_fill = float(state.buffer.count)
+                saved_stale_sum = float(state.buffer.stale_sum)
             checkpoint.save(ckpt_path, state, config=cfg)
+    if save_at is None:
+        save_at = args.rounds  # pathological; resume degenerates to a no-op
 
     # resume: restore the mid-run checkpoint into a FRESH driver and replay
     # the remaining rounds with the same keys — must land bitwise on the
@@ -104,7 +137,7 @@ def _run_check(args):
     fs2, template = build()
     restored = checkpoint.restore(ckpt_path, template, config=cfg)
     state2 = restored
-    for r in range(mid, args.rounds):
+    for r in range(save_at, args.rounds):
         state2, _ = fs2.step(state2, jax.random.fold_in(key, r))
     resumed_equal = all(
         bool(jnp.all(a == b))
@@ -113,6 +146,16 @@ def _run_check(args):
             jax.tree_util.tree_leaves(state2.params),
         )
     )
+    if state.buffer is not None:
+        # async: the aggregation buffer (sums, counts, staleness, ring)
+        # must also land bitwise — it IS part of the resumable state
+        resumed_equal = resumed_equal and all(
+            bool(jnp.all(a == b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(state.buffer),
+                jax.tree_util.tree_leaves(state2.buffer),
+            )
+        )
 
     summary = fs.summary(state)
     run.finish(summary)
@@ -132,6 +175,22 @@ def _run_check(args):
         "uplink_accounted": all(rec["uplink_bytes"] > 0 for rec in rounds_hist),
         "resume_bitwise": resumed_equal,
     }
+    if args.use_async:
+        checks.update(
+            {
+                "staleness_observed": any(
+                    rec.get("staleness_mean", 0.0) > 0 for rec in rounds_hist
+                ),
+                "buffer_applied": sum(
+                    rec.get("applied", 0.0) for rec in rounds_hist
+                )
+                >= 1.0,
+                "checkpoint_mid_buffer": bool(
+                    saved_buffer_fill and saved_buffer_fill > 0
+                    and saved_stale_sum and saved_stale_sum > 0
+                ),
+            }
+        )
     report = {
         "ok": all(checks.values()),
         "checks": checks,
@@ -148,6 +207,20 @@ def _run_check(args):
             "chaos_corrupt_rate": cfg.chaos_corrupt_rate,
         },
     }
+    if args.use_async:
+        st_means = [rec.get("staleness_mean", 0.0) for rec in rounds_hist]
+        report["async"] = {
+            "fed_async_k": cfg.fed_async_k,
+            "fed_async_alpha": cfg.fed_async_alpha,
+            "fed_async_latency": cfg.fed_async_latency,
+            "staleness_mean": sum(st_means) / max(len(st_means), 1),
+            "staleness_max": max(
+                rec.get("staleness_max", 0.0) for rec in rounds_hist
+            ),
+            "applies": sum(rec.get("applied", 0.0) for rec in rounds_hist),
+            "checkpoint_buffer_fill": saved_buffer_fill,
+            "checkpoint_stale_sum": saved_stale_sum,
+        }
     return report
 
 
@@ -172,6 +245,11 @@ def main(argv=None) -> int:
     p_check.add_argument("--num_workers", type=int, default=8)
     p_check.add_argument("--seed", type=int, default=0)
     p_check.add_argument("--track_dir", type=str, default="/tmp/drtpu_fedsim_check")
+    p_check.add_argument(
+        "--async", dest="use_async", action="store_true",
+        help="asynchronous buffered mode: staleness-weighted ingest ticks, "
+             "K-threshold buffered applies, mid-buffer bitwise resume "
+             "(make fedasync-check)")
     args = ap.parse_args(argv)
     if args.platform:
         from deepreduce_tpu.utils import force_platform

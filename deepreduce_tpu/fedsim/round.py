@@ -313,3 +313,153 @@ def cohort_updates(
 def _mask_where(gate_vec: jax.Array, new: jax.Array, old: jax.Array) -> jax.Array:
     g = gate_vec.reshape(gate_vec.shape + (1,) * (new.ndim - gate_vec.ndim))
     return jnp.where(g > 0, new, old)
+
+
+# --------------------------------------------------------------------------- #
+# Asynchronous buffered mode (FedBuff-style): stragglers as a deterministic
+# per-client latency distribution instead of binary churn. A client drawn
+# with latency tau trained from the model as of tau server versions ago;
+# the server down-weights its delta by 1/(1+tau)^alpha and buffers it until
+# K contributions have arrived.
+# --------------------------------------------------------------------------- #
+
+# fold_in domain-separation tag for the latency draw (mirrors faults.py's
+# _DROPOUT_TAG): the async tick derives its latency key from the round key
+# BEFORE the 5-way split, so the five synchronous subkeys stay bit-identical
+_LATENCY_TAG = 0x57A1E
+
+
+def parse_latency(spec: str) -> Tuple[float, ...]:
+    """Parse a latency distribution spec: comma-separated non-negative
+    weights over staleness tau = 0, 1, 2, ..., normalized to probabilities.
+    "" (the default) is zero latency: (1.0,). The tuple length is the
+    overlap depth D — the number of past model versions kept in the w_hist
+    ring, so tau is bounded by D-1 *by construction* (no runtime clamp)."""
+    if not spec:
+        return (1.0,)
+    try:
+        weights = [float(tok) for tok in spec.split(",")]
+    except ValueError as e:
+        raise ValueError(
+            f"fed_async_latency={spec!r}: every comma-separated token must "
+            f"be a float weight ({e})"
+        ) from None
+    if any(w < 0 for w in weights):
+        raise ValueError(
+            f"fed_async_latency={spec!r}: weights are unnormalized "
+            "probabilities and must be >= 0"
+        )
+    total = sum(weights)
+    if total <= 0:
+        raise ValueError(
+            f"fed_async_latency={spec!r}: weights must not all be zero"
+        )
+    if len(weights) > 64:
+        raise ValueError(
+            f"fed_async_latency={spec!r}: {len(weights)} staleness levels — "
+            "the w_hist ring keeps one full model copy per level; cap is 64"
+        )
+    return tuple(w / total for w in weights)
+
+
+def draw_latency(key: jax.Array, probs: Tuple[float, ...], C: int) -> jax.Array:
+    """Draw int32[C] per-client staleness over GLOBAL cohort positions from
+    the shared round key (every worker computes the identical replicated
+    vector — no collective, the same trick as FaultPlan churn). Zero-latency
+    (D == 1) stages no sampling ops at all, keeping the degenerate program
+    minimal."""
+    if len(probs) == 1:
+        return jnp.zeros((C,), jnp.int32)
+    lat_key = jax.random.fold_in(key, _LATENCY_TAG)
+    return jax.random.choice(
+        lat_key, len(probs), (C,), p=jnp.asarray(probs, jnp.float32)
+    ).astype(jnp.int32)
+
+
+def staleness_weights(taus_f: jax.Array, alpha: float) -> jax.Array:
+    """`1/(1+tau)^alpha` down-weighting. alpha == 0.0 (identity) returns
+    exact ones without staging a power — the bitwise-identity contract the
+    degenerate-equivalence test pins."""
+    if alpha == 0.0:
+        return jnp.ones_like(taus_f)
+    return jnp.power(1.0 + taus_f, -alpha)
+
+
+def make_async_client_step(
+    tree_codec: TreeCodec,
+    local_train: Callable[[Any, Any, jax.Array], Any],
+    w_ref: Any,
+    w_hist: Optional[Any],
+    version: jax.Array,
+    taus: jax.Array,
+    alpha: float,
+    step: jax.Array,
+    key_c2s: jax.Array,
+    *,
+    layout=None,
+    chaos=None,
+) -> Callable:
+    """The async variant of `make_client_step`: a client at cohort position
+    `pos` (global) with drawn staleness `taus[pos]` trains from the model as
+    of `version - tau` — read from the replicated `w_hist` ring ([D, ...]
+    leaves; None when D == 1, in which case every client reads `w_ref`
+    directly and the staged program matches the synchronous client step) —
+    and its decoded update is pre-scaled by `1/(1+tau)^alpha` so the
+    cohort sum aggregated by `cohort_updates` is already staleness-weighted.
+
+    `taus` is the GLOBAL int32[C] staleness vector (replicated) — cohort
+    positions are global, so `taus[pos]` is the direct lookup. Same PRNG
+    derivation as the sync step (fold 2*pos / 2*pos + 1): with a
+    zero-latency draw the trained updates are bit-identical to sync's.
+
+    Returns the same `(dec_update_tree, new_res, wire4, ok)` contract, so
+    `cohort_updates` runs it unchanged. The weight multiply happens BEFORE
+    the live-gate SELECT in cohort_updates, and weights are always finite
+    and positive — a corrupt payload's Inf/NaN decode times a finite weight
+    stays Inf/NaN and is then zeroed by SELECT, never by multiply."""
+    use_hist = w_hist is not None
+
+    def client_step(batch_c: Any, res_c: Optional[Any], pos: jax.Array):
+        tau = taus[pos]
+        if use_hist:
+            depth = jax.tree_util.tree_leaves(w_hist)[0].shape[0]
+            slot = jnp.mod(version - tau, depth)
+            ref_c = jax.tree_util.tree_map(lambda h: h[slot], w_hist)
+        else:
+            ref_c = w_ref
+        p_end = local_train(ref_c, batch_c, jax.random.fold_in(key_c2s, 2 * pos))
+        update = tree_sub(p_end, ref_c)
+        payloads, comps, spec = tree_codec.encode_tree(
+            update, res_c, step, jax.random.fold_in(key_c2s, 2 * pos + 1)
+        )
+        dec_leaves = [
+            tree_codec.codec(path, shape).decode(p, step=step).reshape(shape)
+            for path, shape, p in zip(spec.paths, spec.shapes, payloads)
+        ]
+        if layout is not None:
+            buf = layout.pack(payloads)
+            if chaos is not None:
+                buf = chaos.perturb(buf, step=step, worker=pos)
+                recv = layout.unpack(buf)
+                dec_recv = tree_codec.decode_tree(recv, spec, step)
+            else:
+                dec_recv = spec.unflatten(dec_leaves)
+            ok = layout.verify(buf)
+        else:
+            dec_recv = spec.unflatten(dec_leaves)
+            ok = jnp.ones((), jnp.float32)
+        w_c = staleness_weights(jnp.asarray(tau, jnp.float32), alpha)
+        if alpha != 0.0:
+            dec_recv = jax.tree_util.tree_map(lambda u: u * w_c, dec_recv)
+        new_res = (
+            spec.unflatten([c - d for c, d in zip(comps, dec_leaves)])
+            if res_c is not None
+            else None
+        )
+        wire = tree_codec.wire_tree(payloads, spec)
+        wire4 = tuple(
+            jnp.asarray(getattr(wire, f), jnp.float32).reshape(()) for f in WIRE_FIELDS
+        )
+        return dec_recv, new_res, wire4, ok
+
+    return client_step

@@ -49,7 +49,11 @@ from deepreduce_tpu.fedsim.round import (
     FedConfig,
     WIRE_FIELDS,
     cohort_updates,
+    draw_latency,
+    make_async_client_step,
     make_client_step,
+    parse_latency,
+    staleness_weights,
     tree_add,
     tree_sub,
 )
@@ -63,16 +67,85 @@ from deepreduce_tpu.utils.compat import shard_map
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
+class AsyncBuffer:
+    """Server-side aggregation buffer for the asynchronous (FedBuff-style)
+    mode, carried across ingest ticks inside `FedSimState`. Everything here
+    is replicated device state and checkpoints with the rest of the state —
+    a mid-buffer kill/resume replays bitwise.
+
+    - `delta_sum`: staleness-weighted sum of decoded client deltas (tree
+      like params) accumulated since the last apply.
+    - `weight` / `count`: accumulated `sum(1/(1+tau)^alpha)` over live
+      contributions (the apply denominator) and the raw live-contribution
+      count (compared against `k`).
+    - `k`: the apply threshold as a TRACED f32 scalar — a K sweep shares
+      one compiled tick program.
+    - `version`: int32 server model version (number of buffered applies).
+    - `hist`: the w_ref ring — [D, ...] leaves of the last D reference
+      models, one per staleness level of the latency distribution; None
+      when D == 1 (zero latency: clients read w_ref directly and the staged
+      client program matches the synchronous one).
+    - `stale_sum` / `stale_max`: per-buffer staleness counters over the
+      contributions currently buffered (reset at apply) — the "staleness
+      counters nonzero" half of the mid-buffer resume contract.
+    - `pending`: 1.0 when the previous tick applied, so THIS tick pays the
+      S2C broadcast (w_ref advance + downlink bytes); the broadcast ops are
+      always staged and gated by exact SELECTs.
+    """
+
+    delta_sum: Any
+    weight: jax.Array
+    count: jax.Array
+    k: jax.Array
+    version: jax.Array
+    hist: Optional[Any]
+    stale_sum: jax.Array
+    stale_max: jax.Array
+    pending: jax.Array
+
+    def tree_flatten(self):
+        return (
+            (
+                self.delta_sum,
+                self.weight,
+                self.count,
+                self.k,
+                self.version,
+                self.hist,
+                self.stale_sum,
+                self.stale_max,
+                self.pending,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
 class FedSimState:
     params: Any  # server's true model (replicated)
     w_ref: Any  # what every client can reconstruct from broadcasts
     residuals: Optional[Any]  # [num_clients, ...] bank, sharded on dim 0
     round: jax.Array
     telemetry: Optional[MetricAccumulators]
+    # asynchronous aggregation buffer; None in synchronous mode, so the
+    # sync state's pytree leaves (and checkpoints) are unchanged
+    buffer: Optional[AsyncBuffer] = None
 
     def tree_flatten(self):
         return (
-            (self.params, self.w_ref, self.residuals, self.round, self.telemetry),
+            (
+                self.params,
+                self.w_ref,
+                self.residuals,
+                self.round,
+                self.telemetry,
+                self.buffer,
+            ),
             None,
         )
 
@@ -171,6 +244,14 @@ class FedSim:
         self.fault_plan = cfg_c2s.fault_plan if res_on else None
         self.checksum = bool(res_on and cfg_c2s.payload_checksum)
         self.chaos = ChaosInjector.from_config(cfg_c2s)
+        # asynchronous buffered mode (all inert defaults when off: the
+        # synchronous round body/trace is not touched at all)
+        self.fed_async = bool(getattr(cfg_c2s, "fed_async", False))
+        self.async_k = int(getattr(cfg_c2s, "fed_async_k", 0) or 0)
+        self.async_alpha = float(getattr(cfg_c2s, "fed_async_alpha", 0.0))
+        self.latency_probs = parse_latency(
+            getattr(cfg_c2s, "fed_async_latency", "") or ""
+        )
         self.tc_c2s = TreeCodec("c2s", cfg_c2s)
         self.tc_s2c = TreeCodec("s2c", self.cfg_s2c)
         self._layout: Optional[PayloadLayout] = None
@@ -203,7 +284,10 @@ class FedSim:
         self._layout = PayloadLayout(payload_sds, checksum=self.checksum)
 
     def init(self, params: Any) -> FedSimState:
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        # async mode donates the state: take a private copy so the caller's
+        # param arrays survive the first tick (sync keeps the no-copy view)
+        copy = jnp.array if self.fed_async else jnp.asarray
+        params = jax.tree_util.tree_map(copy, params)
         bank = None
         if self.use_res:
             N = self.fed.num_clients
@@ -223,13 +307,45 @@ class FedSim:
         acc = MetricAccumulators.zeros() if self.cfg_c2s.telemetry else None
         if self.checksum or self.chaos is not None:
             self.build_layout(params)
-        self._round = self._build(params)
+        w_ref = jax.tree_util.tree_map(jnp.array, params)
+        buffer = self._init_buffer(w_ref) if self.fed_async else None
+        self._round = self._build_async(params) if self.fed_async else self._build(params)
         return FedSimState(
             params=params,
-            w_ref=jax.tree_util.tree_map(jnp.array, params),
+            w_ref=w_ref,
             residuals=bank,
             round=jnp.zeros((), jnp.int32),
             telemetry=acc,
+            buffer=buffer,
+        )
+
+    def _init_buffer(self, w_ref: Any) -> AsyncBuffer:
+        """Empty aggregation buffer: version 0, pending broadcast (tick 0
+        pays the S2C exactly like synchronous round 0), every w_hist ring
+        slot pre-filled with the initial reference model."""
+        D = len(self.latency_probs)
+        hist = (
+            jax.tree_util.tree_map(
+                lambda w: jnp.repeat(w[None], D, axis=0), w_ref
+            )
+            if D > 1
+            else None
+        )
+        # distinct zero arrays per field: the async program donates the
+        # buffer, and donating one array through two arguments is an error
+        def zero():
+            return jnp.zeros((), jnp.float32)
+
+        return AsyncBuffer(
+            delta_sum=jax.tree_util.tree_map(jnp.zeros_like, w_ref),
+            weight=zero(),
+            count=zero(),
+            k=jnp.asarray(float(max(self.async_k, 1)), jnp.float32),
+            version=jnp.zeros((), jnp.int32),
+            hist=hist,
+            stale_sum=zero(),
+            stale_max=zero(),
+            pending=jnp.ones((), jnp.float32),
         )
 
     # ------------------------------------------------------------------ #
@@ -364,6 +480,220 @@ class FedSim:
         )
         return jax.jit(fn)
 
+    # ------------------------------------------------------------------ #
+    # asynchronous buffered mode: one ingest *tick* — same cohort body as
+    # the synchronous round (same key split, same sampling, same churn),
+    # but client deltas land staleness-weighted in a buffer carried across
+    # ticks, and the server applies only when K contributions have arrived.
+    # ------------------------------------------------------------------ #
+
+    def _async_round_body(self, params, w_ref, bank, acc, rnd, key, buf, widx):
+        fed = self.fed
+        C = fed.clients_per_round
+        C_local, n_local = self.c_local, self.n_local
+        probs = self.latency_probs
+        D = len(probs)
+        alpha = self.async_alpha
+        key_s2c, key_c2s, key_sample, key_part, key_data = jax.random.split(key, 5)
+
+        # --- S2C: staged every tick, *paid* only on ticks following an
+        # apply (`pending` gate). The gates are exact SELECTs / scalar
+        # multiplies by 1.0, so an always-applying run (K == cohort, zero
+        # latency) broadcasts bitwise like the synchronous round.
+        pending = buf.pending
+        delta = tree_sub(params, w_ref)
+        dec_delta, _, wire_s2c = self.tc_s2c.compress_tree(delta, None, rnd, key_s2c)
+        w_ref = jax.tree_util.tree_map(
+            lambda w, d: jnp.where(pending > 0, w + d, w), w_ref, dec_delta
+        )
+        # the ring slot for the CURRENT version always holds the current
+        # reference model (idempotent rewrite on non-broadcast ticks)
+        hist = buf.hist
+        if hist is not None:
+            slot = jnp.mod(buf.version, D)
+            hist = jax.tree_util.tree_map(
+                lambda h, w: h.at[slot].set(w), hist, w_ref
+            )
+
+        # --- cohort sampling / data synthesis / churn: identical to the
+        # synchronous round (same subkeys, same derivations)
+        ids_local = jax.random.choice(
+            jax.random.fold_in(key_sample, widx),
+            n_local,
+            (C_local,),
+            replace=False,
+        )
+        gids = widx * n_local + ids_local
+        positions = jnp.uint32(widx * C_local) + jnp.arange(C_local, dtype=jnp.uint32)
+        batches = jax.vmap(
+            lambda g: self.data_fn(g, rnd, jax.random.fold_in(key_data, g))
+        )(gids)
+        res_stack = (
+            jax.tree_util.tree_map(lambda r: r[ids_local], bank)
+            if self.use_res
+            else None
+        )
+        mask = participation_mask(
+            C, rnd, key_part, drop_rate=self.drop_rate, fault_plan=self.fault_plan
+        )
+        part_local = None
+        if mask is not None:
+            part_local = jax.lax.dynamic_slice(
+                mask.astype(jnp.float32), (widx * C_local,), (C_local,)
+            )
+
+        # --- per-client staleness over GLOBAL cohort positions from the
+        # shared tick key (replicated on every worker — no collective),
+        # exactly the FaultPlan-churn trick
+        taus = draw_latency(key, probs, C)
+
+        client_step = make_async_client_step(
+            self.tc_c2s,
+            self._local_train,
+            w_ref,
+            hist,
+            buf.version,
+            taus,
+            alpha,
+            rnd,
+            key_c2s,
+            layout=self._layout,
+            chaos=self.chaos,
+        )
+        upd_sum, new_res_stack, wire4, live = cohort_updates(
+            client_step,
+            batches,
+            res_stack,
+            positions,
+            update_template=params,
+            participation=part_local,
+            checksum=self.checksum,
+            impl="vmap",
+            chunk=self.client_chunk,
+        )
+        if self.use_res:
+            bank = jax.tree_util.tree_map(
+                lambda b, nr: b.at[ids_local].set(nr), bank, new_res_stack
+            )
+        nlive = jnp.sum(live)
+        sent = jnp.sum(part_local) if part_local is not None else jnp.float32(C_local)
+        nfail = sent - nlive  # transmitted but rejected by the checksum
+        # weighted live mass of this worker's stratum: the apply denominator
+        taus_local = jax.lax.dynamic_slice(taus, (widx * C_local,), (C_local,))
+        wsum = jnp.sum(live * staleness_weights(taus_local.astype(jnp.float32), alpha))
+
+        # --- the tick's ONE cross-worker collective (the fedsim:async-round
+        # audit spec pins it): partial weighted update sums, wire bits,
+        # live/failure counts and the weighted live mass, one psum tuple
+        if self.W > 1:
+            upd_sum, wire4, nlive, nfail, wsum = jax.lax.psum(
+                (upd_sum, wire4, nlive, nfail, wsum), self.axis
+            )
+
+        # --- staleness bookkeeping over TRANSMITTING clients (a
+        # checksum-failed contribution still arrived, with its staleness);
+        # churn and taus are both replicated draws over global positions,
+        # so these stats need no collective
+        taus_f = taus.astype(jnp.float32)
+        if mask is not None:
+            m_f = mask.astype(jnp.float32)
+            sent_global = jnp.sum(m_f)
+            st_sum = jnp.sum(m_f * taus_f)
+            st_max = jnp.maximum(jnp.max(jnp.where(m_f > 0, taus_f, -1.0)), 0.0)
+        else:
+            sent_global = jnp.float32(C)
+            st_sum = jnp.sum(taus_f)
+            st_max = jnp.max(taus_f) if D > 1 else jnp.zeros((), jnp.float32)
+        st_mean = st_sum / jnp.maximum(sent_global, 1.0)
+
+        # --- buffer accumulate, then apply iff >= K contributions buffered
+        new_sum = tree_add(buf.delta_sum, upd_sum)
+        new_weight = buf.weight + wsum
+        new_count = buf.count + nlive
+        new_stale_sum = buf.stale_sum + st_sum
+        new_stale_max = jnp.maximum(buf.stale_max, st_max)
+        applied = (new_count >= buf.k).astype(jnp.float32)
+        denom = jnp.maximum(new_weight, 1.0)
+        new_params = jax.tree_util.tree_map(
+            lambda w, s: jnp.where(applied > 0, w + fed.server_lr * (s / denom), w),
+            params,
+            new_sum,
+        )
+        zero = jnp.zeros((), jnp.float32)
+        new_buf = AsyncBuffer(
+            delta_sum=jax.tree_util.tree_map(
+                lambda s: jnp.where(applied > 0, jnp.zeros_like(s), s), new_sum
+            ),
+            weight=jnp.where(applied > 0, zero, new_weight),
+            count=jnp.where(applied > 0, zero, new_count),
+            k=buf.k,
+            version=buf.version + applied.astype(jnp.int32),
+            hist=hist,
+            stale_sum=jnp.where(applied > 0, zero, new_stale_sum),
+            stale_max=jnp.where(applied > 0, zero, new_stale_max),
+            pending=applied,  # an apply schedules next tick's broadcast
+        )
+
+        # wire accounting: C2S per live uplink + the S2C broadcast on
+        # broadcast ticks only (scalar gate; 1.0 * bits is exact)
+        wire = WireStats(
+            index_bits=wire4[0] + pending * wire_s2c.index_bits,
+            value_bits=wire4[1] + pending * wire_s2c.value_bits,
+            dense_bits=wire4[2] + pending * wire_s2c.dense_bits,
+            saturated=wire4[3] + pending * wire_s2c.saturated,
+        )
+        metrics = {
+            "clients": nlive,
+            "checksum_failures": nfail,
+            "uplink_bytes": (wire4[0] + wire4[1]) / 8.0,
+            "downlink_bytes": pending * wire_s2c.total_bits / 8.0,
+            "rel_volume": wire.rel_volume(),
+            "staleness_mean": st_mean,
+            "staleness_max": st_max,
+            "buffer_fill": new_count,
+            "buffer_weight": new_weight,
+            "applied": applied,
+            "version": new_buf.version.astype(jnp.float32),
+        }
+        if acc is not None:
+            acc = acc.accumulate(
+                wire,
+                live_workers=nlive,
+                dropped_steps=jnp.asarray(nlive < C, jnp.float32),
+                checksum_failures=nfail,
+            )
+        return new_params, w_ref, bank, acc, rnd + 1, metrics, new_buf
+
+    def _build_async(self, params):
+        # donate the heavy carried state (params, w_ref, residual bank,
+        # buffer): the synchronous driver's functional no-donation copy of
+        # the [num_clients, ...] bank is the dominant fixed cost per round
+        # at population scale, and the async tick is explicitly a stream —
+        # state flows forward, nothing rereads the old tick's arrays
+        if self.mesh is None:
+
+            def fn(params, w_ref, bank, acc, rnd, key, buf):
+                return self._async_round_body(
+                    params, w_ref, bank, acc, rnd, key, buf, 0
+                )
+
+            return jax.jit(fn, donate_argnums=(0, 1, 2, 6))
+
+        axis = self.axis
+
+        def spmd(params, w_ref, bank, acc, rnd, key, buf):
+            widx = jax.lax.axis_index(axis)
+            return self._async_round_body(params, w_ref, bank, acc, rnd, key, buf, widx)
+
+        fn = shard_map(
+            spmd,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(axis), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(axis), P(), P(), P(), P()),
+            check_rep=False,
+        )
+        return jax.jit(fn, donate_argnums=(0, 1, 2, 6))
+
     def sharded_round_fn(self) -> Callable:
         """The unjitted round callable (shard_map'd when a mesh is set) —
         what the analysis gate traces on an abstract mesh. Built lazily so
@@ -377,15 +707,34 @@ class FedSim:
                     "sharded_round_fn() when payload_checksum/chaos is "
                     "engaged — the uplink layout is built from param shapes"
                 )
-            self._round = self._build(None)
+            self._round = (
+                self._build_async(None) if self.fed_async else self._build(None)
+            )
         return self._round.__wrapped__  # the pre-jit callable
 
     # ------------------------------------------------------------------ #
 
     def step(self, state: FedSimState, key: jax.Array):
-        """One federated round. Returns (new_state, device metrics dict).
-        Host wall time per round is recorded for `summary()`."""
+        """One federated round (or async ingest tick). Returns
+        (new_state, device metrics dict). Host wall time per round is
+        recorded for `summary()`. In async mode the input state's arrays
+        are DONATED — keep only the returned state."""
         t0 = time.perf_counter()
+        if state.buffer is not None:
+            with spans.span("fedsim/tick"):
+                params, w_ref, bank, acc, rnd, metrics, buf = self._round(
+                    state.params, state.w_ref, state.residuals, state.telemetry,
+                    state.round, key, state.buffer,
+                )
+            jax.block_until_ready(params)
+            self._round_times.append(time.perf_counter() - t0)
+            return (
+                FedSimState(
+                    params=params, w_ref=w_ref, residuals=bank, round=rnd,
+                    telemetry=acc, buffer=buf,
+                ),
+                metrics,
+            )
         with spans.span("fedsim/round"):
             params, w_ref, bank, acc, rnd, metrics = self._round(
                 state.params, state.w_ref, state.residuals, state.telemetry,
@@ -397,6 +746,40 @@ class FedSim:
             params=params, w_ref=w_ref, residuals=bank, round=rnd, telemetry=acc
         )
         return new_state, metrics
+
+    def stream(self, state: FedSimState, key: jax.Array, num_ticks: int):
+        """Dispatch `num_ticks` async ingest ticks back-to-back WITHOUT
+        per-tick host synchronization — the "rounds to a stream" driver.
+        Tick r uses `fold_in(key, r)` with r the state's round counter, so
+        `stream(state, key, T)` lands on exactly the same state as T
+        consecutive `step(state, fold_in(key, r))` calls (the per-tick
+        program is identical; only the host dispatch pattern changes).
+        Returns (final_state, per-tick metrics list, wall_seconds); the
+        per-tick averages land in `self._round_times` for `summary()`."""
+        if state.buffer is None:
+            raise ValueError(
+                "stream() drives the asynchronous buffered mode — build the "
+                "FedSim with fed_async=True (state.buffer is None)"
+            )
+        r0 = int(state.round)  # one host sync up front, none per tick
+        t0 = time.perf_counter()
+        metrics_hist = []
+        with spans.span("fedsim/stream"):
+            for t in range(num_ticks):
+                params, w_ref, bank, acc, rnd, m, buf = self._round(
+                    state.params, state.w_ref, state.residuals, state.telemetry,
+                    state.round, jax.random.fold_in(key, r0 + t), state.buffer,
+                )
+                state = FedSimState(
+                    params=params, w_ref=w_ref, residuals=bank, round=rnd,
+                    telemetry=acc, buffer=buf,
+                )
+                metrics_hist.append(m)
+            jax.block_until_ready(state.params)
+        wall = time.perf_counter() - t0
+        if num_ticks > 0:
+            self._round_times.extend([wall / num_ticks] * num_ticks)
+        return state, metrics_hist, wall
 
     def summary(self, state: FedSimState) -> Dict[str, float]:
         """Host-side round-rate report: clients/sec and uplink volume, from

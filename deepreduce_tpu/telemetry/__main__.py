@@ -287,6 +287,24 @@ def _fedsim_report(hist: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     failures = _series(hist, "checksum_failures")
     if failures:
         out["checksum_failures_total"] = sum(failures)
+    # asynchronous buffered mode: per-tick staleness + buffer digests (the
+    # async driver logs staleness_mean/staleness_max/buffer_fill/applied)
+    st_mean = _series(hist, "staleness_mean")
+    st_max = _series(hist, "staleness_max")
+    if st_mean:
+        out["fed_staleness_mean"] = sum(st_mean) / len(st_mean)
+    if st_max:
+        out["fed_staleness_max"] = max(st_max)
+    fills = [
+        float(r["buffer_fill"])
+        for r in hist
+        if isinstance(r.get("buffer_fill"), (int, float))
+        and float(r.get("applied", 0.0)) > 0
+    ]
+    if fills:
+        # buffer occupancy at the moment of each buffered apply — how far
+        # past the K threshold the ingest stream overshoots
+        out["fed_buffer_fill_per_apply"] = sum(fills) / len(fills)
     return out
 
 
@@ -329,6 +347,15 @@ def cmd_summary(args) -> int:
         if "checksum_failures_total" in fed:
             print(
                 f"    checksum_failures_total: {fed['checksum_failures_total']:.6g}"
+            )
+        if "fed_staleness_mean" in fed:
+            print(f"    fed_staleness_mean: {fed['fed_staleness_mean']:.6g}")
+        if "fed_staleness_max" in fed:
+            print(f"    fed_staleness_max: {fed['fed_staleness_max']:.6g}")
+        if "fed_buffer_fill_per_apply" in fed:
+            print(
+                "    fed_buffer_fill_per_apply: "
+                f"{fed['fed_buffer_fill_per_apply']:.6g}"
             )
     if "ctrl" in rep:
         ctrl = rep["ctrl"]

@@ -28,7 +28,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepreduce_tpu.comm import GradientExchanger
-from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.config import ConfigError, DeepReduceConfig
 from deepreduce_tpu.metrics import WireStats
 from deepreduce_tpu.resilience import faults
 from deepreduce_tpu.telemetry import MetricAccumulators, spans
@@ -273,6 +273,18 @@ class Trainer:
         self.model = model
         self.cfg = cfg
         self.optimizer = optimizer
+        if cfg.fed:
+            # loud fence, not a silent ignore: the federated round (sync or
+            # async) is driven by fedsim.FedSim / fedavg.FedAvg, never by
+            # the data-parallel Trainer — a fed config here would train
+            # with the fed_* (and fed_async*) knobs silently dropped
+            raise ConfigError(
+                "fed-vs-trainer",
+                "fed=True configures the federated simulation "
+                "(deepreduce_tpu.fedsim); the Trainer runs the "
+                "data-parallel gradient exchange and would silently ignore "
+                "every fed_* knob — build a FedSim (or drop fed=True)"
+            )
         if cfg.hier:
             # hierarchical mode runs over a two-axis (dcn, ici) mesh. Build
             # it from cfg.ici_size when none is passed (the one mesh factory

@@ -410,4 +410,29 @@ def test_telemetry_fedsim_report_rates():
     # 32 clients per 2s interval
     assert rep["clients_per_sec"]["mean"] == pytest.approx(16.0)
     assert rep["checksum_failures_total"] == pytest.approx(5.0)
+    # sync runs log no staleness series — the async rows must stay absent
+    assert "fed_staleness_mean" not in rep
+    assert "fed_staleness_max" not in rep
+    assert "fed_buffer_fill_per_apply" not in rep
     assert _fedsim_report([{"ts": 1.0, "loss": 0.5}]) is None  # not a fed run
+
+
+def test_telemetry_fedsim_report_staleness_rows():
+    from deepreduce_tpu.telemetry.__main__ import _fedsim_report
+
+    # async driver history: buffer fills 16/32/48 with an apply at 48
+    hist = [
+        {"ts": 100.0 + 2.0 * i, "round": i, "clients": 16.0,
+         "uplink_bytes": 2048.0, "checksum_failures": 0.0,
+         "staleness_mean": [0.0, 0.5, 1.0][i],
+         "staleness_max": [0.0, 1.0, 2.0][i],
+         "buffer_fill": [16.0, 32.0, 48.0][i],
+         "applied": [0.0, 0.0, 1.0][i]}
+        for i in range(3)
+    ]
+    rep = _fedsim_report(hist)
+    assert rep is not None
+    assert rep["fed_staleness_mean"] == pytest.approx(0.5)
+    assert rep["fed_staleness_max"] == pytest.approx(2.0)
+    # occupancy averaged over APPLY ticks only, not every ingest tick
+    assert rep["fed_buffer_fill_per_apply"] == pytest.approx(48.0)

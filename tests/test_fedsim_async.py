@@ -1,0 +1,289 @@
+"""Asynchronous buffered federated mode (fedsim async): degenerate-case
+equivalence with the synchronous round (bitwise under identity weighting),
+mid-buffer bitwise checkpoint resume, staleness accounting, the stream
+driver, the fed_async* config surface, and the buffered-ingest cost model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepreduce_tpu import checkpoint
+from deepreduce_tpu.config import ConfigError, DeepReduceConfig, reason_code_of
+from deepreduce_tpu.fedsim import FedSim, parse_latency, synthetic_linear_problem
+
+DIM, BATCH, LOCAL = 16, 4, 2
+
+
+def _cfg(**kw):
+    base = dict(
+        deepreduce="index",
+        index="bloom",
+        bloom_blocked="mod",
+        compress_ratio=0.25,
+        fpr=0.01,
+        memory="residual",
+        min_compress_size=8,
+    )
+    base.update(kw)
+    return DeepReduceConfig(**base)
+
+
+def _fed_kw(**kw):
+    base = dict(fed=True, fed_num_clients=64, fed_clients_per_round=16,
+                fed_local_steps=LOCAL)
+    base.update(kw)
+    return base
+
+
+def _driver(cfg, mesh, chunk=2):
+    params0, data_fn, loss_fn = synthetic_linear_problem(DIM, BATCH, LOCAL)
+    fs = FedSim(loss_fn, cfg, cfg.fed_config(), optax.sgd(0.1), data_fn,
+                mesh=mesh, client_chunk=chunk)
+    return fs, fs.init(params0)
+
+
+def _leaves_equal(a, b):
+    return all(
+        bool(jnp.all(x == y))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )
+
+
+def _leaves_close(a, b, **kw):
+    return all(
+        bool(jnp.allclose(x, y, **kw))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )
+
+
+# ---------------------------------------------------------------------- #
+# latency-plan parsing
+# ---------------------------------------------------------------------- #
+
+
+def test_parse_latency():
+    assert parse_latency("") == (1.0,)
+    probs = parse_latency("0.5,0.3,0.2")
+    assert len(probs) == 3
+    assert sum(probs) == pytest.approx(1.0)
+    assert parse_latency("2,1,1") == pytest.approx((0.5, 0.25, 0.25))
+    with pytest.raises(ValueError, match="float"):
+        parse_latency("0.5,x")
+    with pytest.raises(ValueError, match=">= 0"):
+        parse_latency("0.5,-0.1")
+    with pytest.raises(ValueError, match="all be zero"):
+        parse_latency("0,0")
+    with pytest.raises(ValueError, match="cap is 64"):
+        parse_latency(",".join(["1"] * 65))
+
+
+# ---------------------------------------------------------------------- #
+# degenerate-case contract: K == cohort + zero latency == synchronous round
+# ---------------------------------------------------------------------- #
+
+
+def test_async_degenerate_equals_sync(mesh8):
+    """fed_async with K == cohort size and a zero-latency distribution is
+    the synchronous round: bitwise (params AND residual bank) under
+    identity weighting (alpha=0), and within f32 tolerance for alpha>0
+    (the weight is pow(1.0, -alpha) == 1.0, applied through one extra
+    staged multiply)."""
+    key = jax.random.PRNGKey(0)
+    fs_s, st_s = _driver(_cfg(**_fed_kw()), mesh8)
+    for r in range(3):
+        st_s, m_s = fs_s.step(st_s, jax.random.fold_in(key, r))
+
+    fs_a, st_a = _driver(
+        _cfg(**_fed_kw(fed_async=True, fed_async_k=16)), mesh8
+    )
+    m_a = None
+    for r in range(3):
+        st_a, m_a = fs_a.step(st_a, jax.random.fold_in(key, r))
+    assert _leaves_equal(st_s.params, st_a.params)
+    assert _leaves_equal(st_s.residuals, st_a.residuals)
+    # every tick applied (K == cohort, all live) and paid the broadcast
+    assert float(m_a["applied"]) == 1.0
+    assert float(m_a["staleness_mean"]) == 0.0
+    assert float(m_a["downlink_bytes"]) == float(m_s["downlink_bytes"])
+    assert float(m_a["uplink_bytes"]) == float(m_s["uplink_bytes"])
+
+    fs_w, st_w = _driver(
+        _cfg(**_fed_kw(fed_async=True, fed_async_k=16, fed_async_alpha=0.5)),
+        mesh8,
+    )
+    for r in range(3):
+        st_w, _ = fs_w.step(st_w, jax.random.fold_in(key, r))
+    assert _leaves_close(st_s.params, st_w.params, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------- #
+# buffered ingest: fill cadence, staleness, mid-buffer bitwise resume
+# ---------------------------------------------------------------------- #
+
+
+def _async_chaos_cfg():
+    return _cfg(**_fed_kw(
+        fed_async=True, fed_async_k=40, fed_async_alpha=0.5,
+        fed_async_latency="0.5,0.3,0.2",
+        resilience=True, fault_plan="3@1,5@2:4", drop_rate=0.05,
+        payload_checksum=True, chaos_corrupt_rate=0.2,
+    ))
+
+
+def test_async_midbuffer_bitwise_resume(mesh8, tmp_path):
+    """Kill/resume with the buffer partially filled and staleness counters
+    nonzero: restoring the checkpoint into a FRESH driver and replaying the
+    remaining ticks lands bitwise on the uninterrupted run's params,
+    residual bank, AND aggregation buffer (mirrors the r13 sync resume)."""
+    cfg = _async_chaos_cfg()
+    key = jax.random.PRNGKey(0)
+    ck = str(tmp_path / "ckpt")
+    fs, st = _driver(cfg, mesh8)
+    save_at = None
+    for r in range(6):
+        st, _ = fs.step(st, jax.random.fold_in(key, r))
+        if save_at is None and r >= 2 and float(st.buffer.count) > 0 \
+                and float(st.buffer.stale_sum) > 0:
+            save_at = r + 1
+            checkpoint.save(ck, st, config=cfg)
+    assert save_at is not None and save_at < 6  # genuinely mid-buffer, mid-run
+
+    fs2, template = _driver(cfg, mesh8)
+    st2 = checkpoint.restore(ck, template, config=cfg)
+    # the restored buffer is mid-fill with nonzero staleness counters
+    assert float(st2.buffer.count) > 0
+    assert float(st2.buffer.stale_sum) > 0
+    for r in range(save_at, 6):
+        st2, _ = fs2.step(st2, jax.random.fold_in(key, r))
+    assert _leaves_equal(st.params, st2.params)
+    assert _leaves_equal(st.residuals, st2.residuals)
+    assert _leaves_equal(st.buffer, st2.buffer)
+
+
+def test_async_buffer_cadence_and_staleness(mesh8):
+    """K > cohort: the buffer fills across ticks and applies only at the
+    threshold; the S2C broadcast is paid exactly on post-apply ticks; the
+    deterministic latency distribution shows up in the staleness metrics."""
+    cfg = _cfg(**_fed_kw(fed_async=True, fed_async_k=40, fed_async_alpha=0.5,
+                         fed_async_latency="0.5,0.3,0.2"))
+    key = jax.random.PRNGKey(0)
+    fs, st = _driver(cfg, mesh8)
+    hist = []
+    for r in range(6):
+        st, m = fs.step(st, jax.random.fold_in(key, r))
+        hist.append({k: float(v) for k, v in m.items()})
+    # 16 live clients/tick, K=40: applies at ticks 2 and 5 (48 buffered)
+    assert [h["applied"] for h in hist] == [0.0, 0.0, 1.0, 0.0, 0.0, 1.0]
+    assert [h["buffer_fill"] for h in hist] == [16.0, 32.0, 48.0, 16.0, 32.0, 48.0]
+    # broadcast on tick 0 (initial) and on each post-apply tick
+    paid = [h["downlink_bytes"] > 0 for h in hist]
+    assert paid == [True, False, False, True, False, False]
+    assert any(h["staleness_mean"] > 0 for h in hist)
+    assert max(h["staleness_max"] for h in hist) <= 2.0
+    # weighted mass is strictly below the raw count once staleness appears
+    assert any(h["buffer_weight"] < h["buffer_fill"] for h in hist)
+    assert all(
+        bool(jnp.all(jnp.isfinite(x)))
+        for x in jax.tree_util.tree_leaves(st.params)
+    )
+
+
+def test_async_stream_matches_step_loop(mesh8):
+    """stream() only changes the host dispatch pattern: T pipelined ticks
+    land bitwise on the same state as T step() calls."""
+    cfg = _cfg(**_fed_kw(fed_async=True, fed_async_k=40, fed_async_alpha=0.5,
+                         fed_async_latency="0.5,0.3,0.2"))
+    key = jax.random.PRNGKey(3)
+    fs_a, st_a = _driver(cfg, mesh8)
+    for r in range(4):
+        st_a, _ = fs_a.step(st_a, jax.random.fold_in(key, r))
+    fs_b, st_b = _driver(cfg, mesh8)
+    st_b, metrics_hist, wall = fs_b.stream(st_b, key, 4)
+    assert len(metrics_hist) == 4 and wall > 0
+    assert _leaves_equal(st_a.params, st_b.params)
+    assert _leaves_equal(st_a.buffer, st_b.buffer)
+    fs_sync, st_sync = _driver(_cfg(**_fed_kw()), mesh8)
+    with pytest.raises(ValueError, match="fed_async=True"):
+        fs_sync.stream(st_sync, key, 2)
+
+
+# ---------------------------------------------------------------------- #
+# config surface
+# ---------------------------------------------------------------------- #
+
+
+def test_fed_async_config_validation():
+    # engaged knobs without the master flag
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(fed_async_k=8))
+    assert reason_code_of(ei.value) == "fed-async-knobs-disengaged"
+    # async without the fed geometry
+    with pytest.raises(ConfigError) as ei:
+        _cfg(fed_async=True, fed_async_k=8)
+    assert reason_code_of(ei.value) == "fed-async-needs-fed"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(fed_async=True, fed_async_k=0))
+    assert reason_code_of(ei.value) == "fed-async-k-range"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(fed_async=True, fed_async_k=8, fed_async_alpha=-0.5))
+    assert reason_code_of(ei.value) == "fed-async-alpha-range"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(fed_async=True, fed_async_k=8,
+                       fed_async_latency="0.5,nope"))
+    assert reason_code_of(ei.value) == "fed-async-latency-syntax"
+    # a valid async config constructs
+    cfg = _cfg(**_fed_kw(fed_async=True, fed_async_k=8, fed_async_alpha=0.5,
+                         fed_async_latency="0.6,0.3,0.1"))
+    assert cfg.fed_async and cfg.fed_async_k == 8
+
+
+def test_trainer_rejects_fed_config(mesh8):
+    """The Trainer must fail loudly on a fed config instead of silently
+    dropping every fed_* (and fed_async*) knob."""
+    import flax.linen as nn
+
+    from deepreduce_tpu.train import Trainer
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4)(x)
+
+    with pytest.raises(ConfigError) as ei:
+        Trainer(Tiny(), _cfg(**_fed_kw()), optax.sgd(0.1), mesh8)
+    assert reason_code_of(ei.value) == "fed-vs-trainer"
+
+
+# ---------------------------------------------------------------------- #
+# buffered-ingest cost model
+# ---------------------------------------------------------------------- #
+
+
+def test_costmodel_fed_async():
+    from deepreduce_tpu import costmodel as cm
+
+    assert cm.expected_staleness((1.0,)) == 0.0
+    assert cm.expected_staleness((0.5, 0.3, 0.2)) == pytest.approx(0.7)
+
+    # pure-ingest limit: K payloads across the link, same per-byte price
+    # as the synchronous round
+    t = cm.fed_async_apply_time(1000.0, 100)
+    assert t == pytest.approx(100 * 1000.0 / cm.BW_100MBPS)
+    assert cm.fed_async_clients_per_sec(1000.0, 100) == pytest.approx(100 / t)
+    # server links parallelize ingest
+    assert cm.fed_async_apply_time(1000.0, 100, server_links=2) == pytest.approx(t / 2)
+    # client latency is hidden behind ingest (max, not sum): with the same
+    # parameters the async stream serves at least as fast as the sync round
+    sync = cm.fed_clients_per_sec(1000.0, 100, t_client_s=0.5)
+    asyn = cm.fed_async_clients_per_sec(1000.0, 100, t_client_s=0.5)
+    assert asyn >= sync
+    # deeper overlap hides more client compute; staleness stretches it
+    slow = cm.fed_async_apply_time(1.0, 10, t_client_s=4.0, overlap_depth=1)
+    deep = cm.fed_async_apply_time(1.0, 10, t_client_s=4.0, overlap_depth=8)
+    assert deep < slow
+    stale = cm.fed_async_apply_time(
+        1.0, 10, t_client_s=4.0, overlap_depth=1, latency_probs=(0.5, 0.3, 0.2)
+    )
+    assert stale > slow

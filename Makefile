@@ -1,4 +1,4 @@
-.PHONY: analyze analyze-quick matrix-check test test-quick telemetry-check chaos-check fedsim-check fedasync-check ctrl-check overlap-check calibrate-check
+.PHONY: analyze analyze-quick matrix-check test test-quick telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
@@ -7,7 +7,7 @@
 # (chaos-check), the federated round smoke (fedsim-check) and the
 # composition-lattice legality matrix (matrix-check) so none of those
 # paths can rot while the gate stays green.
-analyze: matrix-check telemetry-check chaos-check fedsim-check fedasync-check ctrl-check overlap-check calibrate-check
+analyze: matrix-check telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
 
 # composition-lattice legality gate: probe the full feature cross-product
@@ -54,6 +54,22 @@ fedasync-check:
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.fedsim --platform cpu check \
 		--async --rounds 8 --track_dir $(FEDASYNC_CHECK_DIR)
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry summary $(FEDASYNC_CHECK_DIR)/check
+
+# multi-tenant federated smoke: T=2 heterogeneous async populations
+# (distinct per-tenant K/alpha/latency/cohort) through the ONE vmapped
+# tick on the 8-device CPU mesh — asserts tenant join/leave via the
+# active mask WITHOUT retrace (jit cache size pinned across flips), a
+# MID-FILL multi-tenant checkpoint (tenants at DIFFERENT buffer levels,
+# staleness nonzero) resumes BITWISE replaying the same mask schedule,
+# and restore across a tenant-geometry mismatch fails fast; then the
+# telemetry CLI digests the per-tenant rows (fed_mt_clients_per_sec[t],
+# fed_mt_staleness_mean/max, fed_mt_buffer_fill_per_apply).
+FEDMT_CHECK_DIR := /tmp/drtpu_fedmt_check
+fedmt-check:
+	rm -rf $(FEDMT_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.fedsim --platform cpu check \
+		--tenants 2 --rounds 8 --track_dir $(FEDMT_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry summary $(FEDMT_CHECK_DIR)/mt-check
 
 # resilience smoke: a short 8-worker CPU-mesh train under a FaultPlan drop
 # schedule + wire corruption with payload checksums — asserts finite,

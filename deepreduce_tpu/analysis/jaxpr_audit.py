@@ -669,6 +669,108 @@ def audit_fedsim_async_round(*, d: int = 512) -> List[TraceRecord]:
     return [trace_and_check("fedsim:async-round", fn, args, ctx, payload_bytes=pb)]
 
 
+def audit_fedsim_multitenant(
+    *, d: int = 512, tenants: Tuple[int, ...] = (2, 4)
+) -> List[TraceRecord]:
+    """The multi-tenant tick's amortization contract, pinned at two fleet
+    sizes: stacking T async populations through the one vmapped tick keeps
+    EXACTLY ONE psum — the collective count is independent of T — while
+    the psum tuple's operand bytes grow exactly linearly in T,
+    4*(T*(n_elems+3) + 4) B/worker: the param-leaf update sums and the
+    nlive/nfail/wsum scalars gain a leading tenant dim, while the four
+    wire-accounting scalars are shape-static and tenant-invariant, so vmap
+    leaves them unbatched. Codec count stays at TWO: the vmap over tenants
+    batches the S2C delta encode and the shared C2S client encode instead
+    of re-staging them per tenant — the whole point of serving T
+    populations from one compiled program."""
+    import optax
+
+    from deepreduce_tpu.fedsim.sim import (
+        AsyncBuffer,
+        FedSim,
+        synthetic_linear_problem,
+    )
+
+    tmap = jax.tree_util.tree_map
+    records: List[TraceRecord] = []
+    for T in tenants:
+        cfg = DeepReduceConfig(
+            memory="residual",
+            fed=True,
+            fed_num_clients=64,
+            fed_clients_per_round=16,
+            fed_local_steps=2,
+            fed_async=True,
+            fed_async_k=40,
+            fed_async_alpha=0.5,
+            fed_async_latency="0.5,0.3,0.2",
+            fed_tenants=T,
+            **_FLAGSHIP,
+        )
+        fed = cfg.fed_config()
+        params0, data_fn, loss_fn = synthetic_linear_problem(
+            d, 4, fed.local_steps
+        )
+        fs = FedSim(
+            loss_fn, cfg, fed, optax.sgd(0.1), data_fn, mesh=audit_mesh(),
+            axis=AXIS,
+        )
+        fn = fs.sharded_round_fn()
+        params_sds = tmap(lambda p: _sds(p.shape, p.dtype), params0)
+        stacked_sds = tmap(lambda p: _sds((T,) + p.shape, p.dtype), params_sds)
+        bank_sds = tmap(
+            lambda p: _sds((T, fed.num_clients) + p.shape, p.dtype),
+            params_sds,
+        )
+        D = len(fs.mt_latency[0])
+        buf_sds = AsyncBuffer(
+            delta_sum=stacked_sds,
+            weight=_sds((T,), jnp.float32),
+            count=_sds((T,), jnp.float32),
+            k=_sds((T,), jnp.float32),
+            version=_sds((T,), jnp.int32),
+            hist=tmap(lambda p: _sds((T, D) + p.shape, p.dtype), params_sds),
+            stale_sum=_sds((T,), jnp.float32),
+            stale_max=_sds((T,), jnp.float32),
+            pending=_sds((T,), jnp.float32),
+        )
+        n_elems = sum(
+            int(jnp.prod(jnp.array(p.shape))) if p.shape else 1
+            for p in jax.tree_util.tree_leaves(params_sds)
+        )
+        # batched members (leading tenant dim): param-leaf update sums +
+        # nlive + nfail + wsum; unbatched: the 4 tenant-invariant wire
+        # scalars. Linear in T, one psum regardless of T.
+        pb = 4 * (T * (n_elems + 3) + 4)
+        args = (
+            stacked_sds,  # params [T, ...] (replicated)
+            stacked_sds,  # w_ref [T, ...] (replicated)
+            bank_sds,  # residual bank [T, N, ...], P(None, axis)
+            None,  # telemetry accumulators (off)
+            _sds((T,), jnp.int32),  # per-tenant round counters
+            _sds((2,), jnp.uint32),  # tick key
+            buf_sds,  # stacked aggregation buffers + w_hist rings
+            _sds((T,), jnp.bool_),  # active tenant-slot mask
+            _sds((T,), jnp.float32),  # per-tenant alpha
+            _sds((T, D), jnp.float32),  # per-tenant latency rows
+            None,  # cohort override (off: default trace)
+            _sds((), jnp.int32),  # global tick counter
+        )
+        label = f"fedsim:multi-tenant-T{T}"
+        ctx = AuditContext(
+            label=label,
+            allow_callbacks=False,
+            expect_collectives={"psum": 1},
+            wire_mode="collective",
+            expected_wire_bytes=pb,
+            num_workers=NUM_WORKERS,
+            expect_codec_invocations=2,
+            require_key_lineage=True,
+        )
+        records.append(trace_and_check(label, fn, args, ctx, payload_bytes=pb))
+    return records
+
+
 def _per_tensor_expected_gathers(cfg: DeepReduceConfig, d: int) -> int:
     """fused=False issues one all_gather per payload *leaf* (all_gather maps
     over the pytree) — the static count is the leaf count."""
@@ -1366,6 +1468,10 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
     # the pre-existing record order — and ANALYSIS.json hashes — are
     # stable) ---
     add("fedsim:async-round", lambda: audit_fedsim_async_round())
+    # --- the r21 multi-tenant tick: one psum independent of T, operand
+    # bytes linear in T (registered last so the pre-existing record order —
+    # and ANALYSIS.json hashes — are stable) ---
+    add("fedsim:multi-tenant", lambda: audit_fedsim_multitenant())
     return specs
 
 

@@ -48,10 +48,10 @@ SCHEMA = "deepreduce_tpu/analysis-report/v1"
 
 # (axis name, value labels) in lexicographic cell order. Every label maps
 # to concrete config kwargs in `cell_kwargs`; the cross-product is the
-# probed lattice (4*3*2*2*6*4*2*2*2*2 = 18432 cells). New axes are
+# probed lattice (4*3*2*2*6*4*2*2*2*2*2 = 36864 cells). New axes are
 # appended LAST: product order then expands every pre-existing cell into
 # an adjacent (off, on) pair with the off plane first, so the old lattice
-# survives as the fed_async=off plane and re-baselining can be diffed
+# survives as the fed_mt=off plane and re-baselining can be diffed
 # cell-by-cell.
 AXES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("communicator", ("allgather", "allreduce", "qar", "sparse_rs")),
@@ -64,6 +64,7 @@ AXES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("ctrl", ("off", "on")),
     ("fed", ("off", "on")),
     ("fed_async", ("off", "on")),
+    ("fed_mt", ("off", "on")),
 )
 
 # ctrl + telemetry are host-side only (the audited jx-ctrl-ladder
@@ -147,6 +148,12 @@ def cell_kwargs(cell: Dict[str, str]) -> Dict[str, Any]:
             fed_async=True, fed_async_k=8, fed_async_alpha=0.5,
             fed_async_latency="0.6,0.3,0.1",
         )
+    if cell["fed_mt"] == "on":
+        # without fed=on this cell is ILLEGAL by construction
+        # (fed-mt-needs-fed) — the probe measures exactly that. With
+        # fed=on the T=2 fleet rides the same jitted tick (sync AND
+        # async planes), still exactly one psum.
+        kw.update(fed_tenants=2)
     return kw
 
 
@@ -266,7 +273,14 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
     client-sharded residual bank, wire accounting pinned to the single
     fused psum's 4*(param_elements + 6) B/worker — or, on the fed_async=on
     plane, the buffered ingest tick's 4*(param_elements + 7) (the
-    staleness-weight mass rides the same fused tuple)."""
+    staleness-weight mass rides the same fused tuple).
+
+    On the fed_mt=on plane the T=2 fleet runs through the one vmapped
+    tick: still exactly one psum, operand bytes linear in T. vmap
+    batches the param-leaf sums plus the tenant-varying tuple scalars
+    (nlive/nfail, +wsum when async, +2 wire scalars when the checksum
+    makes wire accounting data-dependent) and leaves the shape-static
+    wire scalars unbatched."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -297,6 +311,62 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
         int(jnp.prod(jnp.array(p.shape))) if p.shape else 1
         for p in jax.tree_util.tree_leaves(params_sds)
     )
+    T = int(getattr(cfg, "fed_tenants", 0) or 0)
+    if T >= 1:
+        data_dep_wire = bool(cfg.payload_checksum or cfg.chaos_corrupt_rate)
+        s_batched = (3 if cfg.fed_async else 2) + (2 if data_dep_wire else 0)
+        s_static = 2 if data_dep_wire else 4
+        pb = 4 * (T * (n_elems + s_batched) + s_static)
+        stacked_sds = tmap(lambda p: ja._sds((T,) + p.shape, p.dtype), params_sds)
+        buf_sds = alpha_sds = lat_sds = None
+        if cfg.fed_async:
+            D = len(fs.mt_latency[0])
+            vec = lambda dt=jnp.float32: ja._sds((T,), dt)
+            buf_sds = AsyncBuffer(
+                delta_sum=stacked_sds,
+                weight=vec(),
+                count=vec(),
+                k=vec(),
+                version=vec(jnp.int32),
+                hist=(
+                    tmap(
+                        lambda p: ja._sds((T, D) + p.shape, p.dtype),
+                        params_sds,
+                    )
+                    if D > 1
+                    else None
+                ),
+                stale_sum=vec(),
+                stale_max=vec(),
+                pending=vec(),
+            )
+            alpha_sds = vec()
+            lat_sds = ja._sds((T, D), jnp.float32)
+        args = (
+            stacked_sds,
+            stacked_sds,
+            tmap(
+                lambda p: ja._sds((T, fed.num_clients) + p.shape, p.dtype),
+                params_sds,
+            ),
+            None,
+            ja._sds((T,), jnp.int32),
+            ja._sds((2,), jnp.uint32),
+            buf_sds,
+            ja._sds((T,), jnp.bool_),
+            alpha_sds,
+            lat_sds,
+            None,
+            ja._sds((), jnp.int32),
+        )
+        ctx = AuditContext(
+            label=label,
+            wire_mode="collective",
+            expected_wire_bytes=pb,
+            num_workers=ja.NUM_WORKERS,
+            require_key_lineage=True,
+        )
+        return ja.trace_and_check(label, fn, args, ctx, payload_bytes=pb)
     pb = 4 * (n_elems + 6 + (1 if cfg.fed_async else 0))
     args = (
         params_sds,

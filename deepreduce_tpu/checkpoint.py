@@ -79,6 +79,22 @@ def _check_stamp(path, cfg: DeepReduceConfig) -> None:
     stamp = retry_io(lambda: json.loads(sp.read_text()))
     want = config_fingerprint(cfg)
     got = stamp.get("fingerprint")
+    # tenant geometry first, with a dedicated message: a multi-tenant
+    # state's every leaf carries a leading [T] dim, so restoring across a
+    # T mismatch isn't a semantics drift — it's a shape error waiting to
+    # happen deep inside orbax. Fail fast and name the geometry. (Legacy
+    # stamps predating fed_tenants read as the single-tenant driver, 0.)
+    stamped_t = int(stamp.get("config", {}).get("fed_tenants", 0) or 0)
+    want_t = int(getattr(cfg, "fed_tenants", 0) or 0)
+    if stamped_t != want_t:
+        raise ValueError(
+            f"checkpoint tenant-geometry mismatch: {sp} was written with "
+            f"fed_tenants={stamped_t} but this run configures "
+            f"fed_tenants={want_t} — a multi-tenant state's leaves are "
+            "stacked [T, ...], so the checkpoint cannot restore into this "
+            "geometry. Use the original fed_tenants, or delete the "
+            "checkpoint to start fresh."
+        )
     if got != want:
         raise ValueError(
             f"checkpoint config mismatch: {sp} was written under config "

@@ -98,6 +98,18 @@ REASON_CODES: Dict[str, str] = {
     "fed-async-k-range": "fed_async_k < 1 with fed_async=True",
     "fed-async-alpha-range": "fed_async_alpha < 0",
     "fed-async-latency-syntax": "fed_async_latency failed parse_latency",
+    "fed-mt-needs-fed": "fed_tenants > 0 without the fed round geometry",
+    "fed-mt-tenants-range": "fed_tenants < 0",
+    "fed-mt-knobs-disengaged": "fed_mt_* knob(s) without fed_tenants >= 1",
+    "fed-mt-async-knobs":
+        "per-tenant K/alpha/latency knob(s) without fed_async=True",
+    "fed-mt-k-syntax": "fed_mt_k failed the per-tenant list parse or has K < 1",
+    "fed-mt-alpha-syntax":
+        "fed_mt_alpha failed the per-tenant list parse or has alpha < 0",
+    "fed-mt-latency-syntax": "fed_mt_latency failed parse_tenant_latency",
+    "fed-mt-cohort-syntax":
+        "fed_mt_cohort failed the per-tenant list parse or has a size "
+        "outside [1, fed_clients_per_round]",
     "ctrl-knobs-disengaged": "ctrl_* knob(s) without ctrl=True",
     "ctrl-needs-telemetry": "ctrl=True without telemetry=True",
     "ctrl-needs-compressor": "ctrl=True with compressor='none'",
@@ -426,6 +438,38 @@ class DeepReduceConfig:
     # like FaultPlan churn, so every worker agrees without a collective.
     # "" = zero latency (every client trains from the current model).
     fed_async_latency: str = ""
+    # multi-tenant federated serving: T independent (model, population)
+    # pairs stacked along a leading tenant axis and vmapped through the ONE
+    # jitted round/tick program, so codec tracing, cohort sampling, and the
+    # single fused psum (tuple operands grow a tenant dim; collective count
+    # stays independent of T) amortize across tenants. 0 (default) is the
+    # plain single-tenant driver — its state pytrees and traced programs
+    # are untouched (pinned by the fedsim:round / fedsim:async-round audit
+    # specs); >= 1 builds the stacked MultiTenantState with an active-mask
+    # ring of tenant slots (tenants join/leave without retracing).
+    fed_tenants: int = 0
+    # per-tenant apply thresholds K (async): comma-separated ints, one per
+    # tenant (or one value broadcast to the fleet). "" = fed_async_k for
+    # every tenant. K is a TRACED buffer leaf, so a K-heterogeneous fleet
+    # shares one compiled tick.
+    fed_mt_k: str = ""
+    # per-tenant staleness exponents alpha (async): comma-separated floats,
+    # broadcast like fed_mt_k. "" = fed_async_alpha everywhere. Rides as a
+    # traced f32[T] operand — re-knobbing a tenant's alpha never retraces.
+    fed_mt_alpha: str = ""
+    # per-tenant latency distributions (async): semicolon-separated
+    # parse_latency comma lists, e.g. "0.5,0.3,0.2;1;0.7,0.3", zero-padded
+    # to the fleet's common overlap depth D = max over tenants (padding is
+    # draw-preserving). "" = fed_async_latency everywhere. The normalized
+    # rows ride as a traced f32[T, D] operand.
+    fed_mt_latency: str = ""
+    # per-tenant effective cohort sizes: comma-separated ints <= the shared
+    # fed_clients_per_round C (broadcast like fed_mt_k). A tenant with
+    # c_t < C gates cohort positions >= c_t out of its round (they never
+    # transmit), so tenant fleets with different per-round demand share the
+    # one static [C]-shaped program; c_t is a traced f32[T] operand. "" =
+    # every tenant runs the full cohort, and NO gate ops are staged.
+    fed_mt_cohort: str = ""
     # adaptive compression controller (deepreduce_tpu.controller): every
     # `telemetry_every` steps the Trainer feeds the fetched
     # MetricAccumulators window delta to a host-side controller that moves
@@ -949,6 +993,102 @@ class DeepReduceConfig:
                 parse_latency(self.fed_async_latency)
             except ValueError as e:
                 raise ConfigError("fed-async-latency-syntax", str(e)) from e
+        # --- multi-tenant federated serving (stacked vmapped tick) ---
+        if self.fed_tenants < 0:
+            raise ConfigError(
+                "fed-mt-tenants-range",
+                f"fed_tenants must be >= 0 (0 = single-tenant driver), got "
+                f"{self.fed_tenants}"
+            )
+        mt_engaged = [
+            name
+            for name in ("fed_mt_k", "fed_mt_alpha", "fed_mt_latency",
+                         "fed_mt_cohort")
+            if getattr(self, name) != ""
+        ]
+        if mt_engaged and self.fed_tenants < 1:
+            raise ConfigError(
+                "fed-mt-knobs-disengaged",
+                f"{', '.join(mt_engaged)} configure per-tenant knobs of the "
+                "multi-tenant federated driver and would be silently "
+                "ignored with fed_tenants=0 — set fed_tenants >= 1 (or "
+                "drop the knob(s))"
+            )
+        if self.fed_tenants >= 1:
+            if not self.fed:
+                raise ConfigError(
+                    "fed-mt-needs-fed",
+                    "fed_tenants >= 1 stacks T federated populations "
+                    "through the one jitted round tick — there is no round "
+                    "to stack without fed=True (set the fed_* geometry too)"
+                )
+            async_knobs = [
+                n for n in ("fed_mt_k", "fed_mt_alpha", "fed_mt_latency")
+                if getattr(self, n) != ""
+            ]
+            if async_knobs and not self.fed_async:
+                raise ConfigError(
+                    "fed-mt-async-knobs",
+                    f"{', '.join(async_knobs)} configure the per-tenant "
+                    "buffered-async knobs (K / alpha / latency) and would "
+                    "be silently ignored with fed_async=False — set "
+                    "fed_async=True (or drop the knob(s))"
+                )
+            # per-tenant list syntax + ranges at construction (deferred
+            # import mirrors the parse_latency check above)
+            from deepreduce_tpu.fedsim.round import (
+                parse_tenant_floats,
+                parse_tenant_latency,
+            )
+
+            T = self.fed_tenants
+            try:
+                ks = parse_tenant_floats(
+                    self.fed_mt_k, T, "fed_mt_k", float(self.fed_async_k)
+                )
+            except ValueError as e:
+                raise ConfigError("fed-mt-k-syntax", str(e)) from e
+            if self.fed_async and any(k < 1 for k in ks):
+                raise ConfigError(
+                    "fed-mt-k-syntax",
+                    f"fed_mt_k={self.fed_mt_k!r}: every per-tenant apply "
+                    "threshold must be >= 1"
+                )
+            try:
+                alphas = parse_tenant_floats(
+                    self.fed_mt_alpha, T, "fed_mt_alpha", self.fed_async_alpha
+                )
+            except ValueError as e:
+                raise ConfigError("fed-mt-alpha-syntax", str(e)) from e
+            if any(a < 0 for a in alphas):
+                raise ConfigError(
+                    "fed-mt-alpha-syntax",
+                    f"fed_mt_alpha={self.fed_mt_alpha!r}: every per-tenant "
+                    "staleness exponent must be >= 0"
+                )
+            try:
+                parse_tenant_latency(
+                    self.fed_mt_latency, T, self.fed_async_latency
+                )
+            except ValueError as e:
+                raise ConfigError("fed-mt-latency-syntax", str(e)) from e
+            try:
+                cohorts = parse_tenant_floats(
+                    self.fed_mt_cohort, T, "fed_mt_cohort",
+                    float(self.fed_clients_per_round),
+                )
+            except ValueError as e:
+                raise ConfigError("fed-mt-cohort-syntax", str(e)) from e
+            if any(
+                c < 1 or c > self.fed_clients_per_round or c != int(c)
+                for c in cohorts
+            ):
+                raise ConfigError(
+                    "fed-mt-cohort-syntax",
+                    f"fed_mt_cohort={self.fed_mt_cohort!r}: every per-tenant "
+                    "effective cohort must be an integer in [1, "
+                    f"fed_clients_per_round={self.fed_clients_per_round}]"
+                )
         # --- adaptive controller: loud failure for silently-ignored knobs ---
         ctrl_engaged = [
             name

@@ -530,6 +530,89 @@ def fed_async_clients_per_sec(
     return k / max(t, 1e-12)
 
 
+def _per_tenant(val, tenants: int, name: str) -> list:
+    """Broadcast a scalar (or length-1 sequence) to `tenants` entries, or
+    validate a per-tenant sequence's length — the costmodel mirror of
+    `parse_tenant_floats` for already-typed values."""
+    if isinstance(val, (list, tuple)):
+        vals = list(val)
+        if len(vals) == 1:
+            vals = vals * tenants
+        if len(vals) != tenants:
+            raise ValueError(
+                f"{name}: got {len(vals)} per-tenant values for a "
+                f"{tenants}-tenant fleet — give 1 (broadcast) or exactly "
+                f"{tenants}"
+            )
+        return vals
+    return [val] * tenants
+
+
+def fed_mt_clients_per_sec(
+    tenants: int,
+    uplink_bytes_per_client,
+    cohort_or_k,
+    bw: float = BW_100MBPS,
+    *,
+    asynchronous: bool = False,
+    t_client_s=0.0,
+    downlink_bytes=0.0,
+    server_links: int = 1,
+    overlap_depth=1,
+    latency_probs=(1.0,),
+) -> float:
+    """Aggregate served clients per second of a T-tenant fleet multiplexed
+    through ONE server (the multi-tenant tick): every tenant's wire crosses
+    the same shared ingest link(s) — wire terms SUM across tenants — while
+    client compute runs concurrently across populations — compute terms
+    take the fleet MAX. Per-tenant heterogeneity rides as sequences (scalar
+    broadcasts), mirroring the fed_mt_* config knobs.
+
+    T=1 collapses EXACTLY (same float expressions, bitwise) to
+    `fed_clients_per_sec` (synchronous) / `fed_async_clients_per_sec`
+    (asynchronous) — the costmodel half of the T=1 degeneracy contract —
+    and the aggregate rate is nondecreasing in T for identical tenants
+    (amortizing the fixed compute term is the whole point; once the shared
+    link saturates the rate plateaus at link capacity, never drops)."""
+    T = int(tenants)
+    if T < 1:
+        raise ValueError(f"tenants must be >= 1, got {tenants}")
+    up = _per_tenant(uplink_bytes_per_client, T, "uplink_bytes_per_client")
+    n = _per_tenant(cohort_or_k, T, "cohort_or_k")
+    dl = _per_tenant(downlink_bytes, T, "downlink_bytes")
+    tc = _per_tenant(t_client_s, T, "t_client_s")
+    links = bw * max(server_links, 1)
+    if not asynchronous:
+        # synchronous rounds: one shared link serializes every tenant's
+        # cohort ingest + broadcast; cohorts train concurrently so the
+        # fleet pays the slowest tenant's client latency once
+        wire = sum(c * u + d for c, u, d in zip(n, up, dl))
+        t = max(tc) + wire / links
+        return sum(n) / max(t, 1e-12)
+    depth = _per_tenant(overlap_depth, T, "overlap_depth")
+    probs = (
+        list(latency_probs)
+        if latency_probs and isinstance(latency_probs[0], (list, tuple))
+        else [latency_probs] * T
+    )
+    if len(probs) == 1:
+        probs = probs * T
+    if len(probs) != T:
+        raise ValueError(
+            f"latency_probs: got {len(probs)} per-tenant rows for a "
+            f"{T}-tenant fleet — give 1 (broadcast) or exactly {T}"
+        )
+    # buffered async: the fleet's apply cadence is gated by total ingest
+    # across tenants vs. the slowest tenant's overlapped compute
+    wire = sum(k * u + d for k, u, d in zip(n, up, dl)) / links
+    compute = max(
+        t * (1.0 + expected_staleness(p)) / max(int(dp), 1)
+        for t, p, dp in zip(tc, probs, depth)
+    )
+    period = max(wire, compute)
+    return sum(n) / max(period, 1e-12)
+
+
 # ---------------------------------------------------------------------------
 # Per-rs_mode static wire accounting. These return the per-worker
 # *injection* bytes of every collective the route issues — the same

@@ -224,8 +224,243 @@ def _run_check(args):
     return report
 
 
+def _mt_rec(m):
+    """Flatten one MT tick's per-tenant metrics ([T] arrays) into a
+    metrics.jsonl row: per-tenant lists under `*_t` keys next to scalar
+    fleet aggregates under the original keys (sums for counts/bytes, max
+    for staleness_max, means otherwise) so the single-tenant telemetry
+    digests keep working on MT runs."""
+    import numpy as np
+
+    SUM = {
+        "clients", "uplink_bytes", "downlink_bytes", "checksum_failures",
+        "applied", "buffer_fill", "buffer_weight",
+    }
+    MAX = {"staleness_max", "version"}
+    rec = {}
+    for k, v in m.items():
+        vals = [float(x) for x in np.asarray(v).reshape(-1)]
+        rec[k + "_t"] = vals
+        if k in SUM:
+            rec[k] = float(sum(vals))
+        elif k in MAX:
+            rec[k] = float(max(vals))
+        else:
+            rec[k] = float(sum(vals) / max(len(vals), 1))
+    return rec
+
+
+def _run_mt_check(args):
+    """Multi-tenant smoke (make fedmt-check): T heterogeneous async
+    populations through the one vmapped tick — join/leave via the active
+    mask WITHOUT retrace, mid-fill checkpoint with tenants at different
+    buffer levels, bitwise resume (replaying the same mask schedule), and
+    a fail-fast restore across a tenant-geometry mismatch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from deepreduce_tpu import checkpoint, tracking
+    from deepreduce_tpu.fedsim.sim import FedSim, synthetic_linear_problem
+
+    T = args.tenants
+    C = args.clients_per_round
+    # a deliberately heterogeneous fleet: alternating K (distinct fill
+    # cadences -> the mid-fill checkpoint catches DIFFERENT levels),
+    # alpha (including the exact-identity 0.0), latency depth, cohorts
+    # odd tenants run HALF cohorts (below), so their K must stay reachable
+    # within the run — ~1.3 cohorts per apply vs. ~2.2 for even tenants
+    ks = ",".join(str(int((2.2 - 1.55 * (t % 2)) * C)) for t in range(T))
+    alphas = ",".join("0" if t % 2 else "0.5" for t in range(T))
+    lats = ";".join("0.5,0.3,0.2" if t % 2 == 0 else "0.6,0.4" for t in range(T))
+    cohorts = [C if t % 2 == 0 else max(C // 2, 1) for t in range(T)]
+    overrides = dict(
+        fed=True,
+        fed_num_clients=args.num_clients,
+        fed_clients_per_round=C,
+        fed_local_steps=2,
+        resilience=True,
+        fault_plan="3@1,5@2:4",
+        drop_rate=0.05,
+        payload_checksum=True,
+        chaos_corrupt_rate=0.2,
+        fed_async=True,
+        fed_async_k=int(2.2 * C),
+        fed_async_alpha=0.5,
+        fed_async_latency="0.5,0.3,0.2",
+        fed_tenants=T,
+        fed_mt_k=ks,
+        fed_mt_alpha=alphas,
+        fed_mt_latency=lats,
+        fed_mt_cohort=",".join(str(c) for c in cohorts),
+    )
+    cfg = _build_cfg(**overrides)
+    fed = cfg.fed_config()
+    dim, batch = 32, 8
+    params0, data_fn, loss_fn = synthetic_linear_problem(dim, batch, fed.local_steps)
+    n_dev = min(args.num_workers, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
+
+    def build():
+        fs = FedSim(
+            loss_fn, cfg, fed, optax.sgd(0.1), data_fn, mesh=mesh, client_chunk=2
+        )
+        return fs, fs.init(params0)
+
+    fs, state = build()
+    key = jax.random.PRNGKey(args.seed)
+    run = tracking.Run(
+        args.track_dir,
+        name="mt-check",
+        config={"fed": fed.__dict__, "fed_tenants": T, "codec": cfg.codec_params()},
+        tags=["fedsim", "mt", "check"],
+    )
+
+    # tenant T-1 leaves for two ticks near the end, then rejoins — the
+    # resume replay repeats this schedule by round index
+    leave = set(range(args.rounds - 3, args.rounds - 1)) if T > 1 else set()
+
+    def mask_for(r):
+        return [not (t == T - 1 and r in leave) for t in range(T)]
+
+    rounds_hist = []
+    ckpt_path = f"{args.track_dir}/ckpt"
+    mid = args.rounds // 2
+    save_at = None
+    saved_fills = saved_stales = None
+    cur_mask = [True] * T
+    frozen_snap = None
+    frozen_ok = True
+    steady_cache = None
+    for r in range(args.rounds):
+        want = mask_for(r)
+        if want != cur_mask:
+            if frozen_snap is None and not all(want):
+                frozen_snap = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x).copy(), state.params
+                )
+            state = fs.set_active(state, want)
+            cur_mask = want
+        state, m = fs.step(state, jax.random.fold_in(key, r))
+        if frozen_snap is not None and not all(cur_mask):
+            # the inactive slot's params must be frozen by exact SELECTs
+            frozen_ok = frozen_ok and all(
+                bool(np.array_equal(np.asarray(a)[T - 1], b[T - 1]))
+                for a, b in zip(
+                    jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(frozen_snap),
+                )
+            )
+        if r == 1:
+            # steady state: the 2nd step's input shardings are the tick's
+            # own outputs (the 1st pays the init->steady recompile)
+            steady_cache = fs._round._cache_size()
+        rec = _mt_rec(m)
+        rounds_hist.append(rec)
+        run.log({"round": r, **rec})
+        if save_at is None and r + 1 >= mid:
+            fills = np.asarray(state.buffer.count)
+            stales = np.asarray(state.buffer.stale_sum)
+            # mid-fill with tenants at DIFFERENT levels, staleness nonzero
+            if fills.min() > 0 and stales.min() > 0 and len(set(fills.tolist())) > 1:
+                save_at = r + 1
+                saved_fills = fills.tolist()
+                saved_stales = stales.tolist()
+                checkpoint.save(ckpt_path, state, config=cfg)
+    no_retrace = (
+        steady_cache is not None and fs._round._cache_size() == steady_cache
+    )
+    if save_at is None:
+        save_at = args.rounds
+
+    # bitwise resume: fresh driver, restore, replay the SAME mask schedule
+    fs2, template = build()
+    resumed_equal = False
+    if save_at < args.rounds:
+        state2 = checkpoint.restore(ckpt_path, template, config=cfg)
+        cur2 = [bool(x) for x in np.asarray(state2.active)]
+        for r in range(save_at, args.rounds):
+            want = mask_for(r)
+            if want != cur2:
+                state2 = fs2.set_active(state2, want)
+                cur2 = want
+            state2, _ = fs2.step(state2, jax.random.fold_in(key, r))
+        resumed_equal = all(
+            bool(jnp.all(a == b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves((state.params, state.buffer, state.residuals)),
+                jax.tree_util.tree_leaves((state2.params, state2.buffer, state2.residuals)),
+            )
+        )
+
+    # tenant-geometry fail-fast: restoring under a different T must raise
+    # the dedicated mismatch error, not a deep orbax shape error
+    t_mismatch_fast = False
+    if save_at < args.rounds:
+        cfg_bad = _build_cfg(**{**overrides, "fed_tenants": T + 1,
+                                "fed_mt_cohort": "", "fed_mt_k": "",
+                                "fed_mt_alpha": "", "fed_mt_latency": ""})
+        try:
+            checkpoint.restore(ckpt_path, template, config=cfg_bad)
+        except ValueError as e:
+            t_mismatch_fast = "tenant-geometry" in str(e)
+
+    summary = fs.summary(state)
+    run.finish(summary)
+
+    w_true = jax.random.normal(jax.random.PRNGKey(42), (dim,))
+    w_errs = [
+        float(jnp.linalg.norm(state.params["w"][t] - w_true) / jnp.linalg.norm(w_true))
+        for t in range(T)
+    ]
+    checks = {
+        "params_finite": all(
+            bool(jnp.all(jnp.isfinite(x)))
+            for x in jax.tree_util.tree_leaves(state.params)
+        ),
+        "model_converging": max(w_errs) < 0.9,
+        "cohorts_respected": all(
+            rec["clients_t"][t] <= cohorts[t] for rec in rounds_hist for t in range(T)
+        ),
+        "uplink_accounted": all(rec["uplink_bytes"] > 0 for rec in rounds_hist),
+        "staleness_observed": any(rec["staleness_mean"] > 0 for rec in rounds_hist),
+        "fleet_applied": sum(rec["applied"] for rec in rounds_hist) >= 1.0,
+        "checkpoint_mid_fill_distinct": bool(
+            saved_fills and min(saved_fills) > 0
+            and len(set(saved_fills)) > 1
+            and saved_stales and min(saved_stales) > 0
+        ),
+        "resume_bitwise": resumed_equal,
+        "join_leave_no_retrace": no_retrace,
+        "frozen_slot_bitwise": frozen_ok and frozen_snap is not None,
+        "t_mismatch_fails_fast": t_mismatch_fast,
+    }
+    report = {
+        "ok": all(checks.values()),
+        "checks": checks,
+        "rounds": args.rounds,
+        "tenants": T,
+        "w_rel_err_per_tenant": w_errs,
+        "clients_per_sec": summary.get("clients_per_sec"),
+        "clients_per_sec_per_tenant": summary.get("clients_per_sec_per_tenant"),
+        "run_dir": str(run.dir),
+        "config": {
+            "fed_num_clients": fed.num_clients,
+            "fed_clients_per_round": fed.clients_per_round,
+            "fed_tenants": T,
+            "fed_mt_k": ks,
+            "fed_mt_alpha": alphas,
+            "fed_mt_latency": lats,
+            "fed_mt_cohort": overrides["fed_mt_cohort"],
+        },
+    }
+    return report
+
+
 def cmd_check(args) -> int:
-    report = _run_check(args)
+    report = _run_mt_check(args) if args.tenants >= 1 else _run_check(args)
     print(json.dumps(report, indent=2))
     return 0 if report["ok"] else 1
 
@@ -250,6 +485,12 @@ def main(argv=None) -> int:
         help="asynchronous buffered mode: staleness-weighted ingest ticks, "
              "K-threshold buffered applies, mid-buffer bitwise resume "
              "(make fedasync-check)")
+    p_check.add_argument(
+        "--tenants", type=int, default=0,
+        help="multi-tenant smoke: T heterogeneous async populations "
+             "through the one vmapped tick — join/leave without retrace, "
+             "mid-fill multi-tenant bitwise resume, per-tenant telemetry "
+             "rows (make fedmt-check)")
     args = ap.parse_args(argv)
     if args.platform:
         from deepreduce_tpu.utils import force_platform

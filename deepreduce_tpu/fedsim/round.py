@@ -362,27 +362,103 @@ def parse_latency(spec: str) -> Tuple[float, ...]:
     return tuple(w / total for w in weights)
 
 
-def draw_latency(key: jax.Array, probs: Tuple[float, ...], C: int) -> jax.Array:
+def draw_latency(key: jax.Array, probs, C: int) -> jax.Array:
     """Draw int32[C] per-client staleness over GLOBAL cohort positions from
     the shared round key (every worker computes the identical replicated
     vector — no collective, the same trick as FaultPlan churn). Zero-latency
     (D == 1) stages no sampling ops at all, keeping the degenerate program
-    minimal."""
-    if len(probs) == 1:
+    minimal.
+
+    `probs` is either the concrete tuple `parse_latency` returns (the
+    single-tenant path — it becomes an XLA constant) or a TRACED f32[D] row
+    (the multi-tenant path: per-tenant distributions ride as traced
+    operands so a heterogeneous fleet shares one compiled program). Both
+    stage the identical choice(cumsum/searchsorted) ops, so a traced row
+    that equals the concrete tuple draws bitwise the same staleness — and a
+    row zero-PADDED to a deeper fleet D keeps its cumsum (and therefore its
+    draws) unchanged too."""
+    D = len(probs) if isinstance(probs, tuple) else int(probs.shape[0])
+    if D == 1:
         return jnp.zeros((C,), jnp.int32)
     lat_key = jax.random.fold_in(key, _LATENCY_TAG)
     return jax.random.choice(
-        lat_key, len(probs), (C,), p=jnp.asarray(probs, jnp.float32)
+        lat_key, D, (C,), p=jnp.asarray(probs, jnp.float32)
     ).astype(jnp.int32)
 
 
-def staleness_weights(taus_f: jax.Array, alpha: float) -> jax.Array:
-    """`1/(1+tau)^alpha` down-weighting. alpha == 0.0 (identity) returns
-    exact ones without staging a power — the bitwise-identity contract the
-    degenerate-equivalence test pins."""
-    if alpha == 0.0:
+def _alpha_is_static_zero(alpha) -> bool:
+    """True iff alpha is a compile-time 0.0 (the static identity-weighting
+    fast path). Traced alphas are never static zero — their multiply is
+    staged and exact at runtime-0.0 (multiply by 1.0)."""
+    return isinstance(alpha, (int, float)) and float(alpha) == 0.0
+
+
+def staleness_weights(taus_f: jax.Array, alpha) -> jax.Array:
+    """`1/(1+tau)^alpha` down-weighting. A static (Python float) alpha of
+    0.0 (identity) returns exact ones without staging a power — the
+    bitwise-identity contract the degenerate-equivalence test pins. A
+    TRACED alpha (the multi-tenant per-tenant knob) always stages the
+    power; at alpha == 0.0 that is `pow(1+tau, -0.0) == 1.0` exactly
+    (IEEE-754), so the multi-tenant T=1 degeneracy stays bitwise."""
+    if _alpha_is_static_zero(alpha):
         return jnp.ones_like(taus_f)
     return jnp.power(1.0 + taus_f, -alpha)
+
+
+# --------------------------------------------------------------------------- #
+# Multi-tenant knob parsing: per-tenant K / alpha / latency / cohort specs.
+# Shared by config validation (syntax at construction) and the FedSim
+# driver (concrete stacked arrays at build).
+# --------------------------------------------------------------------------- #
+
+
+def parse_tenant_floats(
+    spec: str, tenants: int, name: str, default: float
+) -> Tuple[float, ...]:
+    """Parse a comma-separated per-tenant float list. '' broadcasts
+    `default` to every tenant; a single value broadcasts to the fleet;
+    otherwise the list length must equal `tenants`."""
+    if not spec:
+        return (float(default),) * tenants
+    try:
+        vals = [float(tok) for tok in spec.split(",")]
+    except ValueError as e:
+        raise ValueError(
+            f"{name}={spec!r}: every comma-separated token must be a "
+            f"float ({e})"
+        ) from None
+    if len(vals) == 1:
+        vals = vals * tenants
+    if len(vals) != tenants:
+        raise ValueError(
+            f"{name}={spec!r}: got {len(vals)} per-tenant values for a "
+            f"{tenants}-tenant fleet — give 1 (broadcast) or exactly "
+            f"{tenants}"
+        )
+    return tuple(vals)
+
+
+def parse_tenant_latency(
+    spec: str, tenants: int, default: str
+) -> Tuple[Tuple[float, ...], ...]:
+    """Parse a semicolon-separated list of per-tenant latency specs (each
+    one a `parse_latency` comma list), zero-padded to the fleet's common
+    overlap depth D = max over tenants. '' broadcasts `default`; a single
+    spec broadcasts. Zero-padding is draw-preserving: the padded tail adds
+    no probability mass, so a tenant's staleness draws match the ones its
+    unpadded spec would produce."""
+    src = spec if spec else (default or "")
+    rows = [parse_latency(tok) for tok in src.split(";")] if src else [(1.0,)]
+    if len(rows) == 1:
+        rows = rows * tenants
+    if len(rows) != tenants:
+        raise ValueError(
+            f"fed_mt_latency={spec!r}: got {len(rows)} per-tenant latency "
+            f"specs for a {tenants}-tenant fleet — give 1 (broadcast) or "
+            f"exactly {tenants}"
+        )
+    depth = max(len(r) for r in rows)
+    return tuple(r + (0.0,) * (depth - len(r)) for r in rows)
 
 
 def make_async_client_step(
@@ -449,7 +525,7 @@ def make_async_client_step(
             dec_recv = spec.unflatten(dec_leaves)
             ok = jnp.ones((), jnp.float32)
         w_c = staleness_weights(jnp.asarray(tau, jnp.float32), alpha)
-        if alpha != 0.0:
+        if not _alpha_is_static_zero(alpha):
             dec_recv = jax.tree_util.tree_map(lambda u: u * w_c, dec_recv)
         new_res = (
             spec.unflatten([c - d for c, d in zip(comps, dec_leaves)])

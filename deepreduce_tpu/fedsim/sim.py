@@ -53,6 +53,8 @@ from deepreduce_tpu.fedsim.round import (
     make_async_client_step,
     make_client_step,
     parse_latency,
+    parse_tenant_floats,
+    parse_tenant_latency,
     staleness_weights,
     tree_add,
     tree_sub,
@@ -145,6 +147,66 @@ class FedSimState:
                 self.round,
                 self.telemetry,
                 self.buffer,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class MultiTenantState:
+    """T independent federated populations stacked along a leading tenant
+    dimension, served through the ONE jitted round tick (the round body is
+    vmapped over this axis inside the existing `shard_map`, so the tick
+    still issues exactly one psum — its tuple operands just grow a tenant
+    dim; collective COUNT is independent of T).
+
+    - `params` / `w_ref` / `residuals` / `buffer` / `telemetry`: the
+      single-tenant `FedSimState` leaves with a leading `[T]` dim (the
+      residual bank is `[T, num_clients, ...]`, client dim still sharded).
+    - `round`: int32[T] per-tenant round counters — an inactive tenant's
+      counter (and every other leaf) is frozen by exact SELECTs.
+    - `active`: bool[T] tenant-slot ring mask, a TRACED operand — tenants
+      join/leave by flipping bits without retracing (the fed_async
+      pending-gate pattern generalized to whole populations).
+    - `alpha` / `latency` / `cohort`: per-tenant knobs as TRACED stacked
+      scalars/rows (f32[T], f32[T, D], f32[T]) so a heterogeneous fleet
+      shares one compiled program; None when the corresponding subsystem
+      is off (sync mode / no per-tenant cohort override).
+    - `tick`: int32 global tick counter driving the stream key schedule
+      (tenant rounds freeze with their slot; the tick never does).
+    """
+
+    params: Any
+    w_ref: Any
+    residuals: Optional[Any]
+    round: jax.Array
+    telemetry: Optional[MetricAccumulators]
+    buffer: Optional[AsyncBuffer]
+    active: jax.Array
+    alpha: Optional[jax.Array]
+    latency: Optional[jax.Array]
+    cohort: Optional[jax.Array]
+    tick: jax.Array
+
+    def tree_flatten(self):
+        return (
+            (
+                self.params,
+                self.w_ref,
+                self.residuals,
+                self.round,
+                self.telemetry,
+                self.buffer,
+                self.active,
+                self.alpha,
+                self.latency,
+                self.cohort,
+                self.tick,
             ),
             None,
         )
@@ -252,6 +314,36 @@ class FedSim:
         self.latency_probs = parse_latency(
             getattr(cfg_c2s, "fed_async_latency", "") or ""
         )
+        # multi-tenant serving: stack T populations through the one tick
+        # (0 = the single-tenant driver, whose build path is untouched)
+        self.tenants = int(getattr(cfg_c2s, "fed_tenants", 0) or 0)
+        self.mt_k = self.mt_alpha = self.mt_latency = self.mt_cohort = None
+        if self.tenants >= 1:
+            T = self.tenants
+            self.mt_k = parse_tenant_floats(
+                getattr(cfg_c2s, "fed_mt_k", "") or "", T, "fed_mt_k",
+                float(max(self.async_k, 1)),
+            )
+            self.mt_alpha = parse_tenant_floats(
+                getattr(cfg_c2s, "fed_mt_alpha", "") or "", T, "fed_mt_alpha",
+                self.async_alpha,
+            )
+            self.mt_latency = parse_tenant_latency(
+                getattr(cfg_c2s, "fed_mt_latency", "") or "", T,
+                getattr(cfg_c2s, "fed_async_latency", "") or "",
+            )
+            coh_spec = getattr(cfg_c2s, "fed_mt_cohort", "") or ""
+            # the cohort gate stages extra SELECT ops, so it is only wired
+            # when the knob is set (keeps the default MT trace minimal and
+            # the T=1 degeneracy structural)
+            self.mt_cohort = (
+                parse_tenant_floats(
+                    coh_spec, T, "fed_mt_cohort",
+                    float(fed.clients_per_round),
+                )
+                if coh_spec
+                else None
+            )
         self.tc_c2s = TreeCodec("c2s", cfg_c2s)
         self.tc_s2c = TreeCodec("s2c", self.cfg_s2c)
         self._layout: Optional[PayloadLayout] = None
@@ -284,6 +376,8 @@ class FedSim:
         self._layout = PayloadLayout(payload_sds, checksum=self.checksum)
 
     def init(self, params: Any) -> FedSimState:
+        if self.tenants >= 1:
+            return self._init_mt(params)
         # async mode donates the state: take a private copy so the caller's
         # param arrays survive the first tick (sync keeps the no-copy view)
         copy = jnp.array if self.fed_async else jnp.asarray
@@ -348,9 +442,110 @@ class FedSim:
             pending=jnp.ones((), jnp.float32),
         )
 
+    def _init_mt(self, params: Any) -> MultiTenantState:
+        """Stacked multi-tenant initial state: every tenant slot starts
+        from the same caller params (tenant trajectories diverge through
+        their per-tenant PRNG streams), with per-tenant knobs materialized
+        as traced stacked operands. `jnp.stack` gives each stacked field a
+        FRESH buffer — required by async donation."""
+        T = self.tenants
+
+        def stack(tree):
+            return jax.tree_util.tree_map(
+                lambda p: jnp.stack([jnp.asarray(p)] * T), tree
+            )
+
+        params_mt = stack(params)
+        w_ref_mt = stack(params)
+        bank = None
+        if self.use_res:
+            N = self.fed.num_clients
+
+            def _zeros():
+                return jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((T, N) + p.shape, p.dtype), params
+                )
+
+            if self.mesh is not None:
+                # tenant dim replicated, client dim sharded — each worker
+                # still owns a contiguous stratum of every tenant's bank
+                shardings = jax.tree_util.tree_map(
+                    lambda p: NamedSharding(self.mesh, P(None, self.axis)),
+                    params,
+                )
+                bank = jax.jit(_zeros, out_shardings=shardings)()
+            else:
+                bank = _zeros()
+        acc = None
+        if self.cfg_c2s.telemetry:
+            acc = jax.tree_util.tree_map(
+                lambda a: jnp.zeros((T,) + a.shape, a.dtype),
+                MetricAccumulators.zeros(),
+            )
+        if self.checksum or self.chaos is not None:
+            self.build_layout(params)
+        buffer = alpha = latency = None
+        if self.fed_async:
+            D = len(self.mt_latency[0])  # fleet overlap depth (padded)
+            hist = (
+                jax.tree_util.tree_map(
+                    lambda p: jnp.stack(
+                        [jnp.repeat(jnp.asarray(p)[None], D, axis=0)] * T
+                    ),
+                    params,
+                )
+                if D > 1
+                else None
+            )
+
+            def zero_t():
+                return jnp.zeros((T,), jnp.float32)
+
+            buffer = AsyncBuffer(
+                delta_sum=jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((T,) + p.shape, p.dtype), params
+                ),
+                weight=zero_t(),
+                count=zero_t(),
+                k=jnp.asarray(self.mt_k, jnp.float32),
+                version=jnp.zeros((T,), jnp.int32),
+                hist=hist,
+                stale_sum=zero_t(),
+                stale_max=zero_t(),
+                pending=jnp.ones((T,), jnp.float32),
+            )
+            alpha = jnp.asarray(self.mt_alpha, jnp.float32)
+            latency = jnp.asarray(self.mt_latency, jnp.float32)
+        cohort = (
+            jnp.asarray(self.mt_cohort, jnp.float32)
+            if self.mt_cohort is not None
+            else None
+        )
+        self._round = self._build_mt(params)
+        return MultiTenantState(
+            params=params_mt,
+            w_ref=w_ref_mt,
+            residuals=bank,
+            round=jnp.zeros((T,), jnp.int32),
+            telemetry=acc,
+            buffer=buffer,
+            active=jnp.ones((T,), jnp.bool_),
+            alpha=alpha,
+            latency=latency,
+            cohort=cohort,
+            tick=jnp.zeros((), jnp.int32),
+        )
+
+    def set_active(self, state: MultiTenantState, mask) -> MultiTenantState:
+        """Tenant join/leave: flip slots in the active ring mask. The mask
+        is a TRACED operand of the compiled tick, so this never retraces —
+        an inactive slot's state freezes (exact SELECTs) until it rejoins."""
+        act = jnp.asarray(mask, jnp.bool_).reshape(state.active.shape)
+        return dataclasses.replace(state, active=act)
+
     # ------------------------------------------------------------------ #
 
-    def _round_body(self, params, w_ref, bank, acc, rnd, key, widx):
+    def _round_body(self, params, w_ref, bank, acc, rnd, key, widx, *, cohort=None):
         fed = self.fed
         C = fed.clients_per_round
         C_local, n_local = self.c_local, self.n_local
@@ -394,6 +589,16 @@ class FedSim:
         if mask is not None:
             part_local = jax.lax.dynamic_slice(
                 mask.astype(jnp.float32), (widx * C_local,), (C_local,)
+            )
+        if cohort is not None:
+            # per-tenant effective cohort: only global positions < cohort
+            # participate (a traced gate — the heterogeneous fleet shares
+            # one program; staged only when fed_mt_cohort is set)
+            coh_local = (positions.astype(jnp.float32) < cohort).astype(
+                jnp.float32
+            )
+            part_local = (
+                coh_local if part_local is None else part_local * coh_local
             )
 
         client_step = make_client_step(
@@ -487,13 +692,24 @@ class FedSim:
     # ticks, and the server applies only when K contributions have arrived.
     # ------------------------------------------------------------------ #
 
-    def _async_round_body(self, params, w_ref, bank, acc, rnd, key, buf, widx):
+    def _async_round_body(
+        self, params, w_ref, bank, acc, rnd, key, buf, widx,
+        *, alpha=None, latency_row=None, cohort=None,
+    ):
         fed = self.fed
         C = fed.clients_per_round
         C_local, n_local = self.c_local, self.n_local
-        probs = self.latency_probs
-        D = len(probs)
-        alpha = self.async_alpha
+        # multi-tenant callers pass TRACED per-tenant knobs (f32 scalar
+        # alpha, f32[D] latency row, f32 cohort); the single-tenant path
+        # keeps the static config values and stages the identical ops
+        if latency_row is None:
+            probs = self.latency_probs
+            D = len(probs)
+        else:
+            probs = latency_row
+            D = int(latency_row.shape[0])
+        if alpha is None:
+            alpha = self.async_alpha
         key_s2c, key_c2s, key_sample, key_part, key_data = jax.random.split(key, 5)
 
         # --- S2C: staged every tick, *paid* only on ticks following an
@@ -540,6 +756,19 @@ class FedSim:
         if mask is not None:
             part_local = jax.lax.dynamic_slice(
                 mask.astype(jnp.float32), (widx * C_local,), (C_local,)
+            )
+        coh_global = None
+        if cohort is not None:
+            # per-tenant effective cohort over GLOBAL positions (replicated
+            # draw-free gate; staged only when fed_mt_cohort is set)
+            coh_global = (
+                jnp.arange(C, dtype=jnp.float32) < cohort
+            ).astype(jnp.float32)
+            coh_local = jax.lax.dynamic_slice(
+                coh_global, (widx * C_local,), (C_local,)
+            )
+            part_local = (
+                coh_local if part_local is None else part_local * coh_local
             )
 
         # --- per-client staleness over GLOBAL cohort positions from the
@@ -595,7 +824,19 @@ class FedSim:
         # churn and taus are both replicated draws over global positions,
         # so these stats need no collective
         taus_f = taus.astype(jnp.float32)
-        if mask is not None:
+        if coh_global is not None:
+            # cohort-gated transmitters: compose the gate with churn (the
+            # cohort branch is staged only when fed_mt_cohort is set, so
+            # the default trace below stays byte-identical)
+            m_f = (
+                coh_global
+                if mask is None
+                else mask.astype(jnp.float32) * coh_global
+            )
+            sent_global = jnp.sum(m_f)
+            st_sum = jnp.sum(m_f * taus_f)
+            st_max = jnp.maximum(jnp.max(jnp.where(m_f > 0, taus_f, -1.0)), 0.0)
+        elif mask is not None:
             m_f = mask.astype(jnp.float32)
             sent_global = jnp.sum(m_f)
             st_sum = jnp.sum(m_f * taus_f)
@@ -694,6 +935,113 @@ class FedSim:
         )
         return jax.jit(fn, donate_argnums=(0, 1, 2, 6))
 
+    # ------------------------------------------------------------------ #
+    # multi-tenant serving: T stacked populations through the ONE tick —
+    # the round body (sync or async) is vmapped over the tenant axis
+    # INSIDE the shard_map, so codec tracing, cohort sampling and the
+    # single fused psum amortize across tenants (the psum tuple operands
+    # grow a leading [T]; collective count stays 1, independent of T).
+    # ------------------------------------------------------------------ #
+
+    def _build_mt(self, params):
+        T = self.tenants
+        asynchronous = self.fed_async
+
+        def tick_fn(
+            params, w_ref, bank, acc, rnds, key, buf,
+            active, alpha, latency, cohort, tick, widx,
+        ):
+            # per-tenant key streams: tenant 0 replays the single-tenant
+            # stream EXACTLY (bitwise T=1 degeneracy); every other slot
+            # gets a fold_in-domain-separated stream
+            tids = jnp.arange(T, dtype=jnp.uint32)
+            folded = jax.vmap(lambda t: jax.random.fold_in(key, t))(tids)
+            keys = jnp.where((tids == 0)[:, None], key[None, :], folded)
+
+            def one(params_t, w_ref_t, bank_t, acc_t, rnd_t, key_t,
+                    buf_t, act_t, alpha_t, lat_t, coh_t):
+                if asynchronous:
+                    (n_params, n_w_ref, n_bank, n_acc, n_rnd, metrics,
+                     n_buf) = self._async_round_body(
+                        params_t, w_ref_t, bank_t, acc_t, rnd_t, key_t,
+                        buf_t, widx,
+                        alpha=alpha_t, latency_row=lat_t, cohort=coh_t,
+                    )
+                else:
+                    n_params, n_w_ref, n_bank, n_acc, n_rnd, metrics = (
+                        self._round_body(
+                            params_t, w_ref_t, bank_t, acc_t, rnd_t, key_t,
+                            widx, cohort=coh_t,
+                        )
+                    )
+                    n_buf = buf_t  # None
+                # inactive slot: freeze every carried leaf by exact SELECT
+                # (the pending-gate pattern generalized), zero its metrics
+
+                def frz(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(act_t, n, o), new, old
+                    )
+
+                n_params = frz(n_params, params_t)
+                n_w_ref = frz(n_w_ref, w_ref_t)
+                n_bank = frz(n_bank, bank_t)
+                n_acc = frz(n_acc, acc_t)
+                n_rnd = jnp.where(act_t, n_rnd, rnd_t)
+                n_buf = frz(n_buf, buf_t)
+                act_f = act_t.astype(jnp.float32)
+                metrics = jax.tree_util.tree_map(
+                    lambda m: m * act_f, metrics
+                )
+                return n_params, n_w_ref, n_bank, n_acc, n_rnd, metrics, n_buf
+
+            (n_params, n_w_ref, n_bank, n_acc, n_rnds, metrics, n_buf) = (
+                jax.vmap(one)(
+                    params, w_ref, bank, acc, rnds, keys, buf,
+                    active, alpha, latency, cohort,
+                )
+            )
+            return (
+                n_params, n_w_ref, n_bank, n_acc, n_rnds, metrics, n_buf,
+                tick + 1,
+            )
+
+        donate = (0, 1, 2, 6) if asynchronous else ()
+        if self.mesh is None:
+
+            def fn(params, w_ref, bank, acc, rnds, key, buf,
+                   active, alpha, latency, cohort, tick):
+                return tick_fn(
+                    params, w_ref, bank, acc, rnds, key, buf,
+                    active, alpha, latency, cohort, tick, 0,
+                )
+
+            return jax.jit(fn, donate_argnums=donate)
+
+        axis = self.axis
+
+        def spmd(params, w_ref, bank, acc, rnds, key, buf,
+                 active, alpha, latency, cohort, tick):
+            widx = jax.lax.axis_index(axis)
+            return tick_fn(
+                params, w_ref, bank, acc, rnds, key, buf,
+                active, alpha, latency, cohort, tick, widx,
+            )
+
+        fn = shard_map(
+            spmd,
+            mesh=self.mesh,
+            # bank is [T, num_clients, ...]: tenant dim replicated, client
+            # dim sharded — everything else replicated as before
+            in_specs=(
+                P(), P(), P(None, axis), P(), P(), P(), P(),
+                P(), P(), P(), P(), P(),
+            ),
+            out_specs=(P(), P(), P(None, axis), P(), P(), P(), P(), P()),
+            check_rep=False,
+        )
+        return jax.jit(fn, donate_argnums=donate)
+
     def sharded_round_fn(self) -> Callable:
         """The unjitted round callable (shard_map'd when a mesh is set) —
         what the analysis gate traces on an abstract mesh. Built lazily so
@@ -707,9 +1055,12 @@ class FedSim:
                     "sharded_round_fn() when payload_checksum/chaos is "
                     "engaged — the uplink layout is built from param shapes"
                 )
-            self._round = (
-                self._build_async(None) if self.fed_async else self._build(None)
-            )
+            if self.tenants >= 1:
+                self._round = self._build_mt(None)
+            else:
+                self._round = (
+                    self._build_async(None) if self.fed_async else self._build(None)
+                )
         return self._round.__wrapped__  # the pre-jit callable
 
     # ------------------------------------------------------------------ #
@@ -720,6 +1071,27 @@ class FedSim:
         recorded for `summary()`. In async mode the input state's arrays
         are DONATED — keep only the returned state."""
         t0 = time.perf_counter()
+        if isinstance(state, MultiTenantState):
+            with spans.span("fedsim/mt-tick"):
+                (params, w_ref, bank, acc, rnds, metrics, buf, tick) = (
+                    self._round(
+                        state.params, state.w_ref, state.residuals,
+                        state.telemetry, state.round, key, state.buffer,
+                        state.active, state.alpha, state.latency,
+                        state.cohort, state.tick,
+                    )
+                )
+            jax.block_until_ready(params)
+            self._round_times.append(time.perf_counter() - t0)
+            return (
+                MultiTenantState(
+                    params=params, w_ref=w_ref, residuals=bank, round=rnds,
+                    telemetry=acc, buffer=buf, active=state.active,
+                    alpha=state.alpha, latency=state.latency,
+                    cohort=state.cohort, tick=tick,
+                ),
+                metrics,
+            )
         if state.buffer is not None:
             with spans.span("fedsim/tick"):
                 params, w_ref, bank, acc, rnd, metrics, buf = self._round(
@@ -761,19 +1133,42 @@ class FedSim:
                 "stream() drives the asynchronous buffered mode — build the "
                 "FedSim with fed_async=True (state.buffer is None)"
             )
-        r0 = int(state.round)  # one host sync up front, none per tick
+        mt = isinstance(state, MultiTenantState)
+        # one host sync up front, none per tick; the MT tick key schedule
+        # follows the GLOBAL tick counter (tenant rounds freeze with their
+        # slot), which equals the round counter when tenant 0 never leaves
+        # — the bitwise T=1 degeneracy contract
+        r0 = int(state.tick) if mt else int(state.round)
         t0 = time.perf_counter()
         metrics_hist = []
         with spans.span("fedsim/stream"):
             for t in range(num_ticks):
-                params, w_ref, bank, acc, rnd, m, buf = self._round(
-                    state.params, state.w_ref, state.residuals, state.telemetry,
-                    state.round, jax.random.fold_in(key, r0 + t), state.buffer,
-                )
-                state = FedSimState(
-                    params=params, w_ref=w_ref, residuals=bank, round=rnd,
-                    telemetry=acc, buffer=buf,
-                )
+                tick_key = jax.random.fold_in(key, r0 + t)
+                if mt:
+                    (params, w_ref, bank, acc, rnds, m, buf, tick) = (
+                        self._round(
+                            state.params, state.w_ref, state.residuals,
+                            state.telemetry, state.round, tick_key,
+                            state.buffer, state.active, state.alpha,
+                            state.latency, state.cohort, state.tick,
+                        )
+                    )
+                    state = MultiTenantState(
+                        params=params, w_ref=w_ref, residuals=bank,
+                        round=rnds, telemetry=acc, buffer=buf,
+                        active=state.active, alpha=state.alpha,
+                        latency=state.latency, cohort=state.cohort,
+                        tick=tick,
+                    )
+                else:
+                    params, w_ref, bank, acc, rnd, m, buf = self._round(
+                        state.params, state.w_ref, state.residuals,
+                        state.telemetry, state.round, tick_key, state.buffer,
+                    )
+                    state = FedSimState(
+                        params=params, w_ref=w_ref, residuals=bank, round=rnd,
+                        telemetry=acc, buffer=buf,
+                    )
                 metrics_hist.append(m)
             jax.block_until_ready(state.params)
         wall = time.perf_counter() - t0
@@ -785,11 +1180,15 @@ class FedSim:
         """Host-side round-rate report: clients/sec and uplink volume, from
         the telemetry accumulators plus the recorded round wall times. The
         first recorded round is dropped when possible (it pays compile)."""
+        mt = isinstance(state, MultiTenantState)
         out: Dict[str, float] = {
             "clients_per_round": float(self.fed.clients_per_round),
             "num_clients": float(self.fed.num_clients),
             "rounds": float(len(self._round_times)),
         }
+        if mt:
+            out["fed_tenants"] = float(self.tenants)
+            out["active_tenants"] = float(jnp.sum(state.active))
         times = self._round_times
         if len(times) > 1:
             times = times[1:]
@@ -797,8 +1196,20 @@ class FedSim:
             per_round = sum(times) / len(times)
             out["round_time_s"] = per_round
             out["clients_per_sec"] = self.fed.clients_per_round / per_round
+            if mt:
+                # aggregate fleet throughput (the headline the MT tick is
+                # for) next to the per-tenant rate
+                out["clients_per_sec_per_tenant"] = out["clients_per_sec"]
+                out["clients_per_sec"] *= max(out["active_tenants"], 1.0)
         if state.telemetry is not None:
-            tele = state.telemetry.summary()
+            tele_acc = state.telemetry
+            if mt:
+                # per-tenant counters → fleet totals (the per-tenant rows
+                # live in the step/stream metrics history)
+                tele_acc = jax.tree_util.tree_map(
+                    lambda x: jnp.sum(x, axis=0), tele_acc
+                )
+            tele = tele_acc.summary()
             steps = max(tele["steps"], 1.0)
             out.update(tele)
             # uplink: scarce-link bits net of the S2C broadcast is not

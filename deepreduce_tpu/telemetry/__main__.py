@@ -305,6 +305,79 @@ def _fedsim_report(hist: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         # buffer occupancy at the moment of each buffered apply — how far
         # past the K threshold the ingest stream overshoots
         out["fed_buffer_fill_per_apply"] = sum(fills) / len(fills)
+    # multi-tenant rows: the MT driver logs per-tenant LISTS under *_t keys
+    # next to the scalar fleet aggregates digested above — render the
+    # tenant-indexed variants of the r20 rows
+    mt = _mt_fedsim_rows(hist)
+    if mt:
+        out.update(mt)
+    return out
+
+
+def _mt_series(hist: List[Dict[str, Any]], key: str) -> List[List[float]]:
+    return [
+        [float(x) for x in r[key]]
+        for r in hist
+        if isinstance(r.get(key), list) and r[key]
+    ]
+
+
+def _mt_fedsim_rows(hist: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Tenant-indexed fedsim digests from the per-tenant `*_t` list rows
+    the multi-tenant driver logs ({} for single-tenant runs). Each output
+    is a length-T list, index = tenant slot."""
+    clients_t = _mt_series(hist, "clients_t")
+    if not clients_t:
+        return {}
+    T = max(len(row) for row in clients_t)
+    out: Dict[str, Any] = {"fed_tenants": T}
+    # per-tenant clients/sec: pair each tick's per-tenant live count with
+    # the wall interval to the previous record (first interval dropped,
+    # like the aggregate rate)
+    recs = [
+        r for r in hist
+        if isinstance(r.get("ts"), (int, float))
+        and isinstance(r.get("clients_t"), list)
+    ]
+    rates: List[List[float]] = [[] for _ in range(T)]
+    for prev, cur in zip(recs, recs[1:]):
+        dt = cur["ts"] - prev["ts"]
+        if dt <= 0:
+            continue
+        for t, c in enumerate(cur["clients_t"][:T]):
+            rates[t].append(float(c) / dt)
+    rates = [r[1:] if len(r) > 2 else r for r in rates]
+    if any(rates):
+        out["fed_mt_clients_per_sec"] = [
+            (sum(r) / len(r)) if r else 0.0 for r in rates
+        ]
+    st_mean_t = _mt_series(hist, "staleness_mean_t")
+    if st_mean_t:
+        out["fed_mt_staleness_mean"] = [
+            sum(row[t] for row in st_mean_t) / len(st_mean_t) for t in range(T)
+        ]
+    st_max_t = _mt_series(hist, "staleness_max_t")
+    if st_max_t:
+        out["fed_mt_staleness_max"] = [
+            max(row[t] for row in st_max_t) for t in range(T)
+        ]
+    # per-tenant buffer occupancy at that tenant's own applies (the
+    # tenant-indexed fed_buffer_fill_per_apply)
+    fill_rows = [
+        r for r in hist
+        if isinstance(r.get("buffer_fill_t"), list)
+        and isinstance(r.get("applied_t"), list)
+    ]
+    if fill_rows:
+        fills_t: List[List[float]] = [[] for _ in range(T)]
+        for r in fill_rows:
+            for t, (f, a) in enumerate(zip(r["buffer_fill_t"], r["applied_t"])):
+                if t < T and float(a) > 0:
+                    fills_t[t].append(float(f))
+        if any(fills_t):
+            out["fed_mt_buffer_fill_per_apply"] = [
+                (sum(f) / len(f)) if f else 0.0 for f in fills_t
+            ]
     return out
 
 
@@ -357,6 +430,17 @@ def cmd_summary(args) -> int:
                 "    fed_buffer_fill_per_apply: "
                 f"{fed['fed_buffer_fill_per_apply']:.6g}"
             )
+        if "fed_tenants" in fed:
+            print(f"    fed_tenants: {fed['fed_tenants']}")
+            for row in (
+                "fed_mt_clients_per_sec",
+                "fed_mt_staleness_mean",
+                "fed_mt_staleness_max",
+                "fed_mt_buffer_fill_per_apply",
+            ):
+                if row in fed:
+                    vals = ", ".join(f"{v:.6g}" for v in fed[row])
+                    print(f"    {row}: [{vals}]")
     if "ctrl" in rep:
         ctrl = rep["ctrl"]
         print("  ctrl (adaptive compression controller):")

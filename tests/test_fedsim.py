@@ -436,3 +436,40 @@ def test_telemetry_fedsim_report_staleness_rows():
     assert rep["fed_staleness_max"] == pytest.approx(2.0)
     # occupancy averaged over APPLY ticks only, not every ingest tick
     assert rep["fed_buffer_fill_per_apply"] == pytest.approx(48.0)
+
+
+def test_telemetry_fedsim_report_mt_rows():
+    """Per-tenant `*_t` list rows from the multi-tenant driver become the
+    tenant-indexed report rows — rates, staleness mean/max, buffer fill —
+    each a length-T list; single-tenant histories emit none of them."""
+    from deepreduce_tpu.telemetry.__main__ import _fedsim_report
+
+    hist = [
+        {"ts": 100.0 + 2.0 * i, "round": i, "clients": 24.0,
+         "uplink_bytes": 2048.0, "checksum_failures": 0.0,
+         "clients_t": [16.0, 8.0],
+         "staleness_mean_t": [[0.0, 0.0], [0.0, 0.5], [0.0, 1.0]][i],
+         "staleness_max_t": [[0.0, 0.0], [0.0, 1.0], [0.0, 2.0]][i],
+         "buffer_fill_t": [[16.0, 8.0], [32.0, 16.0], [48.0, 24.0]][i],
+         "applied_t": [[0.0, 0.0], [0.0, 0.0], [1.0, 1.0]][i]}
+        for i in range(3)
+    ]
+    rep = _fedsim_report(hist)
+    assert rep is not None
+    assert rep["fed_tenants"] == 2
+    # each tenant's live count over each 2s interval (first interval kept:
+    # only two intervals exist)
+    assert rep["fed_mt_clients_per_sec"] == pytest.approx([8.0, 4.0])
+    assert rep["fed_mt_staleness_mean"] == pytest.approx([0.0, 0.5])
+    assert rep["fed_mt_staleness_max"] == pytest.approx([0.0, 2.0])
+    # per-tenant occupancy at that tenant's OWN applies
+    assert rep["fed_mt_buffer_fill_per_apply"] == pytest.approx([48.0, 24.0])
+    # a single-tenant history carries no tenant-indexed rows
+    solo = _fedsim_report(
+        [{"ts": 1.0 + i, "round": i, "clients": 16.0,
+          "uplink_bytes": 2048.0, "checksum_failures": 0.0}
+         for i in range(3)]
+    )
+    assert solo is not None
+    assert "fed_tenants" not in solo
+    assert "fed_mt_clients_per_sec" not in solo

@@ -1,0 +1,300 @@
+"""Multi-tenant federated serving (fedsim fed_tenants): bitwise T=1
+degeneracy against the single-tenant driver (sync AND async planes),
+heterogeneous per-tenant knobs through the one compiled tick, tenant
+join/leave without retracing, mid-fill multi-tenant checkpoint resume with
+the tenant-geometry fail-fast, and the multi-tenant cost model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepreduce_tpu import checkpoint
+from deepreduce_tpu.config import ConfigError, DeepReduceConfig, reason_code_of
+from deepreduce_tpu.fedsim import FedSim, synthetic_linear_problem
+
+DIM, BATCH, LOCAL = 16, 4, 2
+
+
+def _cfg(**kw):
+    base = dict(
+        deepreduce="index",
+        index="bloom",
+        bloom_blocked="mod",
+        compress_ratio=0.25,
+        fpr=0.01,
+        memory="residual",
+        min_compress_size=8,
+    )
+    base.update(kw)
+    return DeepReduceConfig(**base)
+
+
+def _fed_kw(**kw):
+    base = dict(fed=True, fed_num_clients=64, fed_clients_per_round=16,
+                fed_local_steps=LOCAL)
+    base.update(kw)
+    return base
+
+
+def _async_kw(**kw):
+    base = _fed_kw(fed_async=True, fed_async_k=40, fed_async_alpha=0.5,
+                   fed_async_latency="0.5,0.3,0.2")
+    base.update(kw)
+    return base
+
+
+def _driver(cfg, mesh, chunk=2):
+    params0, data_fn, loss_fn = synthetic_linear_problem(DIM, BATCH, LOCAL)
+    fs = FedSim(loss_fn, cfg, cfg.fed_config(), optax.sgd(0.1), data_fn,
+                mesh=mesh, client_chunk=chunk)
+    return fs, fs.init(params0)
+
+
+def _leaves_equal(a, b):
+    return all(
+        bool(jnp.all(x == y))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )
+
+
+def _tenant(tree, t):
+    """Slice tenant t's plane out of a stacked multi-tenant pytree."""
+    return jax.tree_util.tree_map(lambda x: x[t], tree)
+
+
+# ---------------------------------------------------------------------- #
+# T=1 degeneracy: the multi-tenant tick IS the single-tenant round
+# ---------------------------------------------------------------------- #
+
+
+def test_mt_t1_degenerate_sync(mesh8):
+    """fed_tenants=1 on the synchronous plane is the single-tenant round,
+    bitwise: tenant 0 replays the exact PRNG stream (the tenant-0 key is
+    the undevided round key), so params AND the residual bank agree to the
+    byte after several rounds."""
+    key = jax.random.PRNGKey(0)
+    fs_s, st_s = _driver(_cfg(**_fed_kw()), mesh8)
+    fs_m, st_m = _driver(_cfg(**_fed_kw(fed_tenants=1)), mesh8)
+    for r in range(3):
+        k = jax.random.fold_in(key, r)
+        st_s, m_s = fs_s.step(st_s, k)
+        st_m, m_m = fs_m.step(st_m, k)
+    assert _leaves_equal(st_s.params, _tenant(st_m.params, 0))
+    assert _leaves_equal(st_s.w_ref, _tenant(st_m.w_ref, 0))
+    assert _leaves_equal(st_s.residuals, _tenant(st_m.residuals, 0))
+    assert float(np.asarray(m_m["clients"]).reshape(-1)[0]) == float(
+        m_s["clients"]
+    )
+
+
+def test_mt_t1_degenerate_async(mesh8):
+    """fed_tenants=1 on the async plane: the buffered ingest tick with the
+    fed_async_* knobs broadcast to the one tenant lands bitwise on the
+    single-tenant async driver — params, residual bank, AND every
+    aggregation-buffer leaf (fill, staleness counters, w_hist ring)."""
+    key = jax.random.PRNGKey(0)
+    fs_a, st_a = _driver(_cfg(**_async_kw()), mesh8)
+    fs_m, st_m = _driver(_cfg(**_async_kw(fed_tenants=1)), mesh8)
+    for r in range(4):
+        k = jax.random.fold_in(key, r)
+        st_a, _ = fs_a.step(st_a, k)
+        st_m, _ = fs_m.step(st_m, k)
+    assert _leaves_equal(st_a.params, _tenant(st_m.params, 0))
+    assert _leaves_equal(st_a.residuals, _tenant(st_m.residuals, 0))
+    for sa, sm in zip(
+        jax.tree_util.tree_leaves(st_a.buffer),
+        jax.tree_util.tree_leaves(_tenant(st_m.buffer, 0)),
+    ):
+        assert bool(jnp.all(sa == sm))
+
+
+# ---------------------------------------------------------------------- #
+# heterogeneous fleet through ONE compiled program
+# ---------------------------------------------------------------------- #
+
+
+def test_mt_heterogeneous_knobs(mesh8):
+    """Per-tenant K/alpha/latency ride as traced operands of the shared
+    tick: a zero-latency tenant accrues zero staleness while its neighbor
+    (drawing from a 3-level distribution) does not, and per-tenant K sets
+    distinct apply cadences — all without a second compiled program."""
+    cfg = _cfg(**_async_kw(
+        fed_tenants=2, fed_mt_k="16,40", fed_mt_alpha="0,0.5",
+        fed_mt_latency="1;0.5,0.3,0.2",
+    ))
+    key = jax.random.PRNGKey(0)
+    fs, st = _driver(cfg, mesh8)
+    applied, stale = [], []
+    for r in range(6):
+        st, m = fs.step(st, jax.random.fold_in(key, r))
+        applied.append(np.asarray(m["applied"], dtype=np.float64))
+        stale.append(np.asarray(m["staleness_mean"], dtype=np.float64))
+    # the zero-latency tenant never goes stale; its neighbor does
+    assert all(s[0] == 0.0 for s in stale)
+    assert max(s[1] for s in stale) > 0.0
+    # K=16 == cohort: tenant 0 applies every tick; K=40: ticks 2, 5, ...
+    assert [a[0] for a in applied] == [1.0] * 6
+    assert [a[1] for a in applied] == [0.0, 0.0, 1.0, 0.0, 0.0, 1.0]
+
+
+def test_mt_join_leave_freeze_no_retrace(mesh8):
+    """Flipping the active-slot mask is a traced operand: an inactive
+    tenant's whole state (params, bank, buffer) freezes bitwise, and the
+    flip adds ZERO new jit cache entries (no retrace)."""
+    cfg = _cfg(**_async_kw(fed_tenants=2))
+    key = jax.random.PRNGKey(1)
+    fs, st = _driver(cfg, mesh8)
+    for r in range(2):
+        st, _ = fs.step(st, jax.random.fold_in(key, r))
+    steady_cache = fs._round._cache_size()
+    frozen_params = _tenant(st.params, 1)
+    frozen_buf = _tenant(st.buffer, 1)
+    st = fs.set_active(st, [True, False])
+    for r in range(2, 4):
+        st, m = fs.step(st, jax.random.fold_in(key, r))
+        # a parked slot serves nobody
+        assert float(np.asarray(m["clients"])[1]) == 0.0
+    assert _leaves_equal(frozen_params, _tenant(st.params, 1))
+    assert _leaves_equal(frozen_buf, _tenant(st.buffer, 1))
+    # the active tenant kept moving
+    assert not _leaves_equal(_tenant(st.params, 0), _tenant(st.params, 1))
+    st = fs.set_active(st, [True, True])
+    st, _ = fs.step(st, jax.random.fold_in(key, 4))
+    assert fs._round._cache_size() == steady_cache
+
+
+# ---------------------------------------------------------------------- #
+# mid-fill checkpoint kill/resume + tenant-geometry fail-fast
+# ---------------------------------------------------------------------- #
+
+
+def test_mt_midfill_bitwise_resume(mesh8, tmp_path):
+    """Kill/resume with the tenants' buffers at DIFFERENT fill levels:
+    restoring into a fresh driver and replaying the remaining ticks lands
+    bitwise on the uninterrupted run — params, bank, and both tenants'
+    aggregation buffers. A checkpoint stamped for T=2 must fail fast
+    against a T=3 config instead of shape-erroring mid-restore."""
+    cfg = _cfg(**_async_kw(fed_tenants=2, fed_mt_k="24,56"))
+    key = jax.random.PRNGKey(0)
+    ck = str(tmp_path / "ckpt")
+    fs, st = _driver(cfg, mesh8)
+    save_at = None
+    for r in range(6):
+        st, _ = fs.step(st, jax.random.fold_in(key, r))
+        fills = np.asarray(st.buffer.count, dtype=np.float64)
+        stales = np.asarray(st.buffer.stale_sum, dtype=np.float64)
+        if save_at is None and fills.min() > 0 and stales.max() > 0 \
+                and len(set(fills.tolist())) > 1:
+            save_at = r + 1
+            checkpoint.save(ck, st, config=cfg)
+    assert save_at is not None and save_at < 6  # genuinely mid-fill, mid-run
+
+    fs2, template = _driver(cfg, mesh8)
+    st2 = checkpoint.restore(ck, template, config=cfg)
+    fills = np.asarray(st2.buffer.count, dtype=np.float64)
+    assert fills.min() > 0 and len(set(fills.tolist())) > 1
+    for r in range(save_at, 6):
+        st2, _ = fs2.step(st2, jax.random.fold_in(key, r))
+    assert _leaves_equal(st.params, st2.params)
+    assert _leaves_equal(st.residuals, st2.residuals)
+    assert _leaves_equal(st.buffer, st2.buffer)
+
+    cfg_bad = _cfg(**_async_kw(fed_tenants=3, fed_mt_k="24,56,56"))
+    fs3, template3 = _driver(cfg_bad, mesh8)
+    with pytest.raises(ValueError, match="tenant-geometry"):
+        checkpoint.restore(ck, template3, config=cfg_bad)
+
+
+# ---------------------------------------------------------------------- #
+# config surface
+# ---------------------------------------------------------------------- #
+
+
+def test_fed_mt_config_validation():
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(fed_tenants=-1))
+    assert reason_code_of(ei.value) == "fed-mt-tenants-range"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_async_kw(fed_mt_k="16,40"))
+    assert reason_code_of(ei.value) == "fed-mt-knobs-disengaged"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(fed_tenants=2)
+    assert reason_code_of(ei.value) == "fed-mt-needs-fed"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(fed_tenants=2, fed_mt_k="16,40"))
+    assert reason_code_of(ei.value) == "fed-mt-async-knobs"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_async_kw(fed_tenants=2, fed_mt_k="16,nope"))
+    assert reason_code_of(ei.value) == "fed-mt-k-syntax"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_async_kw(fed_tenants=2, fed_mt_alpha="0.5,-1"))
+    assert reason_code_of(ei.value) == "fed-mt-alpha-syntax"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_async_kw(fed_tenants=2, fed_mt_latency="0.5,0.5;oops"))
+    assert reason_code_of(ei.value) == "fed-mt-latency-syntax"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_async_kw(fed_tenants=2, fed_mt_cohort="16,0"))
+    assert reason_code_of(ei.value) == "fed-mt-cohort-syntax"
+    # a valid heterogeneous fleet constructs
+    cfg = _cfg(**_async_kw(fed_tenants=2, fed_mt_k="16,40",
+                           fed_mt_alpha="0,0.5",
+                           fed_mt_latency="1;0.5,0.3,0.2",
+                           fed_mt_cohort="16,8"))
+    assert cfg.fed_tenants == 2
+
+
+# ---------------------------------------------------------------------- #
+# multi-tenant cost model
+# ---------------------------------------------------------------------- #
+
+
+def test_costmodel_fed_mt_t1_exact():
+    """T=1 collapses EXACTLY (same float expressions, not approximately)
+    onto the single-tenant models, sync and async."""
+    from deepreduce_tpu import costmodel as cm
+
+    assert cm.fed_mt_clients_per_sec(
+        1, 1000.0, 100, t_client_s=0.5
+    ) == cm.fed_clients_per_sec(1000.0, 100, t_client_s=0.5)
+    assert cm.fed_mt_clients_per_sec(
+        1, 1000.0, 100, asynchronous=True, t_client_s=0.5,
+        overlap_depth=4, latency_probs=(0.5, 0.3, 0.2),
+    ) == cm.fed_async_clients_per_sec(
+        1000.0, 100, t_client_s=0.5, overlap_depth=4,
+        latency_probs=(0.5, 0.3, 0.2),
+    )
+
+
+def test_costmodel_fed_mt_monotone():
+    """While client compute dominates, aggregate service rate grows with
+    tenant count (shared tick, no per-tenant collective tax) on both
+    planes; once the serialized server link saturates, adding tenants is
+    free but not faster. Per-tenant lists are length-validated."""
+    from deepreduce_tpu import costmodel as cm
+
+    rates = [
+        cm.fed_mt_clients_per_sec(
+            T, 1000.0, 100, asynchronous=True, t_client_s=10.0
+        )
+        for T in (1, 2, 4, 8)
+    ]
+    assert all(b > a for a, b in zip(rates, rates[1:]))
+    # near-linear while compute-bound
+    assert rates[2] / rates[0] > 3.0
+    # ingest-bound limit: the shared link caps the aggregate (flat, never
+    # decreasing)
+    wire_bound = [
+        cm.fed_mt_clients_per_sec(T, 1000.0, 100, asynchronous=True)
+        for T in (1, 2, 4)
+    ]
+    assert wire_bound[0] == pytest.approx(wire_bound[-1])
+    sync_rates = [
+        cm.fed_mt_clients_per_sec(T, 1000.0, 100, t_client_s=10.0)
+        for T in (1, 2, 4)
+    ]
+    assert all(b > a for a, b in zip(sync_rates, sync_rates[1:]))
+    # heterogeneous per-tenant lists must match T
+    with pytest.raises(ValueError, match="per-tenant"):
+        cm.fed_mt_clients_per_sec(3, 1000.0, [100, 50], asynchronous=True)

@@ -1,4 +1,4 @@
-.PHONY: analyze analyze-quick matrix-check test test-quick telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check
+.PHONY: analyze analyze-quick matrix-check memcheck test test-quick telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
@@ -7,8 +7,16 @@
 # (chaos-check), the federated round smoke (fedsim-check) and the
 # composition-lattice legality matrix (matrix-check) so none of those
 # paths can rot while the gate stays green.
-analyze: matrix-check telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check
+analyze: memcheck matrix-check telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
+
+# memory-liveness gate: the donation-aware liveness interpreter over the
+# flagship fused/bucketed/streaming/fedsim traces — prints each trace's
+# modeled peak live bytes, the top-3 contributing buffers with provenance,
+# and the live bytes at each collective; exits nonzero on any violation
+# (jx-peak-bytes residency, jx-dtype-flow, or any other armed rule).
+memcheck:
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis mem
 
 # composition-lattice legality gate: probe the full feature cross-product
 # (communicator x decode x buckets x stream x rs_mode x hier x resilience

@@ -8,9 +8,18 @@ Commands:
 - ``matrix``: probe the full composition lattice (analysis/lattice.py),
   rebuild MATRIX.json, and diff it against the committed baseline. Exits 1
   on any rule violation, any codeless rejection, or any legality /
-  reason-code / trace-hash drift vs the baseline; ``--update`` rewrites
-  the baseline instead of failing on drift.
+  reason-code / trace-hash / peak-byte drift vs the baseline; ``--update``
+  rewrites the baseline instead of failing on drift. Prints memoization +
+  wall-time stats (cells probed, fingerprint cache hits, seconds) so
+  lattice-widening PRs can see their audit-cost budget.
+- ``mem``: the liveness interpreter over the flagship fused / bucketed /
+  streaming / fedsim traces — a human-readable peak + top-3 buffer table
+  with provenance. Exits 1 on any violation in those traces.
 - ``list``: print every rule id with its one-line contract and exit.
+
+``audit`` additionally gates jx-peak-bytes: each trace's modeled peak live
+bytes must equal the budget committed in ANALYSIS.json. Drift exits 1
+without touching the baseline; ``--update`` re-baselines deliberately.
 
 ``--only RULE[,RULE]`` restricts the failure gate (and the printed
 violations) to the named rules — the full audit still runs and the report
@@ -57,14 +66,41 @@ def _cmd_list() -> int:
     return 0
 
 
+def _load_budgets(path):
+    """Committed per-trace peak budgets from an existing ANALYSIS.json;
+    {} when there is no baseline (or it predates peak accounting)."""
+    try:
+        baseline = json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return {}
+    budgets = {}
+    for t in baseline.get("jaxpr_audit", {}).get("traces", []):
+        if t.get("peak_bytes") is not None:
+            budgets[t["label"]] = t["peak_bytes"]
+    return budgets
+
+
 def _cmd_audit(args, only) -> int:
     from deepreduce_tpu.analysis.ast_lint import lint_repo
-    from deepreduce_tpu.analysis.jaxpr_audit import audit_all
+    from deepreduce_tpu.analysis.jaxpr_audit import (
+        audit_all,
+        peak_budget_violations,
+    )
     from deepreduce_tpu.analysis.lattice import SCHEMA
 
     root = Path(__file__).resolve().parents[2]
     ast_violations = lint_repo(root)
     records, jaxpr_violations = audit_all(quick=args.quick)
+
+    # jx-peak-bytes budget gate: compare fresh peaks against the committed
+    # baseline at the output path BEFORE overwriting it. --quick audits a
+    # subset, so only the labels it produced are compared. --update skips
+    # the comparison and re-baselines deliberately.
+    out_path = args.out if args.out is not None else root / "ANALYSIS.json"
+    budget_drift = []
+    if not args.update and str(out_path) != "-":
+        budget_drift = peak_budget_violations(records, _load_budgets(out_path))
+        jaxpr_violations = jaxpr_violations + budget_drift
 
     violations = [v.to_dict() for v in ast_violations + jaxpr_violations]
     skipped = [r.label for r in records if r.skipped is not None]
@@ -85,10 +121,16 @@ def _cmd_audit(args, only) -> int:
         },
     }
 
-    out_path = args.out if args.out is not None else root / "ANALYSIS.json"
     if str(out_path) != "-":
-        out_path.write_text(json.dumps(report, indent=2, sort_keys=True) + "\n")
-        print(f"wrote {out_path}")
+        if budget_drift:
+            # leave the committed baseline alone on drift — re-baselining
+            # a busted budget must be a deliberate --update
+            print(f"NOT writing {out_path} (peak budget drift)")
+        else:
+            out_path.write_text(
+                json.dumps(report, indent=2, sort_keys=True) + "\n"
+            )
+            print(f"wrote {out_path}")
 
     gate = _gate(violations, only)
     print(
@@ -102,20 +144,76 @@ def _cmd_audit(args, only) -> int:
     return 1 if gate else 0
 
 
+# the memcheck flagships: the fused + bucketed exchange loops, the
+# backprop-overlapped streaming step, and the federated round
+MEM_LABELS = (
+    "exchange:fused-loop",
+    "exchange:bucketed-loop",
+    "exchange:streaming",
+    "fedsim:round",
+)
+
+
+def _human_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n} B"
+
+
+def _cmd_mem(args, only) -> int:
+    from deepreduce_tpu.analysis.jaxpr_audit import audit_specs
+
+    records = []
+    for label, thunk in audit_specs():
+        if label in MEM_LABELS:
+            records.extend(thunk())
+
+    for rec in records:
+        print(f"{rec.label}: peak {rec.peak_bytes} B "
+              f"({_human_bytes(rec.peak_bytes or 0)}) live")
+        for buf in rec.peak_top or []:
+            print(
+                f"    {_human_bytes(buf['bytes']):>10}  "
+                f"{buf['dtype']}{buf['shape']}  "
+                f"<- {buf['prim']} @ {buf['site']}"
+            )
+        for prim, live in sorted((rec.collective_residency or {}).items()):
+            print(f"    at {prim}: {_human_bytes(live)} live")
+
+    gate = _gate([v.to_dict() for r in records for v in r.violations], only)
+    for v in gate:
+        print(f"  [{v['rule']}] {v['where']}: {v['detail']}", file=sys.stderr)
+    print(f"memcheck: {len(records)} flagship traces, {len(gate)} violations")
+    return 1 if gate else 0
+
+
 def _cmd_matrix(args, only) -> int:
+    import time
+
     from deepreduce_tpu.analysis import lattice
 
     root = Path(__file__).resolve().parents[2]
     baseline_path = args.out if args.out is not None else root / "MATRIX.json"
 
+    stats = {}
+    t0 = time.monotonic()
     report = lattice.build_matrix(
-        progress=lambda m: print(f"  {m}", flush=True)
+        progress=lambda m: print(f"  {m}", flush=True), stats=stats
     )
+    wall = time.monotonic() - t0
     s = report["summary"]
     print(
         f"matrix: {s['cells']} cells -> {s['legal']} legal / "
         f"{s['rejected']} rejected ({len(s['reason_codes'])} reason codes, "
         f"{s['distinct_traces']} distinct traces)"
+    )
+    # audit-cost budget line (printed only — never written to the baseline)
+    print(
+        f"matrix cost: {stats.get('cells_probed', 0)} cells probed, "
+        f"{stats.get('cache_hits', 0)} fingerprint cache hits, "
+        f"{wall:.1f}s wall"
     )
 
     gate = _gate(report["violations"], only)
@@ -150,9 +248,10 @@ def main(argv=None) -> int:
         "command",
         nargs="?",
         default="audit",
-        choices=("audit", "matrix", "list"),
+        choices=("audit", "matrix", "mem", "list"),
         help="audit (default): fixed trace list -> ANALYSIS.json; "
         "matrix: full composition lattice -> MATRIX.json; "
+        "mem: liveness peak + top-3 buffer table for the flagship traces; "
         "list: print the rule table",
     )
     parser.add_argument(
@@ -177,8 +276,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--update",
         action="store_true",
-        help="matrix: rewrite the committed baseline instead of failing "
-        "on drift",
+        help="rewrite the committed baseline instead of failing on drift "
+        "(matrix: legality/hash/peak; audit: jx-peak-bytes budgets)",
     )
     parser.add_argument(
         "--out",
@@ -194,6 +293,8 @@ def main(argv=None) -> int:
     only = _parse_only(args.only, parser)
     if args.command == "matrix":
         return _cmd_matrix(args, only)
+    if args.command == "mem":
+        return _cmd_mem(args, only)
     return _cmd_audit(args, only)
 
 

@@ -57,6 +57,10 @@ class FlatEqn:
     prim: str
     eqn: Any  # None for sources
     in_refs: Tuple[Ref, ...]
+    # aval per produced value (position-aligned with the node's out refs);
+    # a source node has exactly one. The liveness interpreter prices
+    # buffers off these.
+    out_avals: Tuple[Any, ...] = ()
 
 
 @dataclasses.dataclass
@@ -75,6 +79,9 @@ class DataflowGraph:
     donations: List[Donation]
     # per-node ancestor bitset over node idxs (sources included)
     anc: List[int]
+    # resolved refs of the top-level jaxpr outvars — the values that stay
+    # live through the end of the program (liveness roots)
+    out_refs: Tuple[Ref, ...] = ()
 
     def by_prim(self, name: str) -> List[FlatEqn]:
         return [fe for fe in self.nodes if fe.prim == name]
@@ -124,13 +131,20 @@ def build_graph(closed_jaxpr: Any) -> DataflowGraph:
     donations: List[Donation] = []
     env: Dict[Any, Ref] = {}
 
-    def new_node(prim: str, eqn: Any, in_refs: Tuple[Ref, ...]) -> FlatEqn:
-        fe = FlatEqn(len(nodes), prim, eqn, in_refs)
+    def new_node(
+        prim: str, eqn: Any, in_refs: Tuple[Ref, ...],
+        out_avals: Tuple[Any, ...] = (),
+    ) -> FlatEqn:
+        fe = FlatEqn(len(nodes), prim, eqn, in_refs, out_avals)
         nodes.append(fe)
         return fe
 
     def source(var: Any, kind: str) -> None:
-        env[var] = (new_node(f"source:{kind}", None, ()).idx, 0)
+        aval = getattr(var, "aval", None)
+        env[var] = (
+            new_node(f"source:{kind}", None, (), (aval,) if aval is not None else ()).idx,
+            0,
+        )
 
     for v in jaxpr.constvars:
         source(v, "const")
@@ -151,7 +165,10 @@ def build_graph(closed_jaxpr: Any) -> DataflowGraph:
             in_refs = tuple(ref_of(v) for v in eqn.invars)
             sub = _inline_target(eqn)
             if sub is None:
-                fe = new_node(eqn.primitive.name, eqn, in_refs)
+                fe = new_node(
+                    eqn.primitive.name, eqn, in_refs,
+                    tuple(getattr(ov, "aval", None) for ov in eqn.outvars),
+                )
                 for pos, ov in enumerate(eqn.outvars):
                     env[ov] = (fe.idx, pos)
                 continue
@@ -179,6 +196,7 @@ def build_graph(closed_jaxpr: Any) -> DataflowGraph:
                 )
 
     emit(jaxpr)
+    out_refs = tuple(ref_of(ov) for ov in jaxpr.outvars)
 
     anc = [0] * len(nodes)
     for fe in nodes:
@@ -188,7 +206,9 @@ def build_graph(closed_jaxpr: Any) -> DataflowGraph:
                 i = r[0]
                 a |= anc[i] | (1 << i)
         anc[fe.idx] = a
-    return DataflowGraph(nodes=nodes, donations=donations, anc=anc)
+    return DataflowGraph(
+        nodes=nodes, donations=donations, anc=anc, out_refs=out_refs
+    )
 
 
 # ---------------------------------------------------------------------- #

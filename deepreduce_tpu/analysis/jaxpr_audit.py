@@ -30,10 +30,12 @@ import jax
 import jax.numpy as jnp
 
 from deepreduce_tpu import memory
+from deepreduce_tpu.analysis import liveness
 from deepreduce_tpu.analysis.rules import (
     AuditContext,
     R_CALIB_RESELECT,
     R_CTRL_LADDER,
+    R_PEAK_BYTES,
     R_RESILIENCE_OFF,
     R_RETRACE,
     Violation,
@@ -66,6 +68,14 @@ class TraceRecord:
     skipped: Optional[str] = None
     # {mesh axis: {prim: count}} — the fabric-split view of `collectives`
     collectives_by_axis: Optional[Dict[str, Dict[str, int]]] = None
+    # the liveness interpreter's priced memory envelope (analysis/liveness):
+    # modeled peak live bytes under the trace's topological schedule, the
+    # top contributing buffers at the peak, and the live-byte residency at
+    # each collective. peak_bytes doubles as the committed per-trace byte
+    # budget jx-peak-bytes gates against.
+    peak_bytes: Optional[int] = None
+    peak_top: Optional[List[Dict[str, Any]]] = None
+    collective_residency: Optional[Dict[str, int]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -80,6 +90,12 @@ class TraceRecord:
             out["skipped"] = self.skipped
         if self.collectives_by_axis:
             out["collectives_by_axis"] = self.collectives_by_axis
+        if self.peak_bytes is not None:
+            out["peak_bytes"] = self.peak_bytes
+        if self.peak_top is not None:
+            out["peak_top"] = self.peak_top
+        if self.collective_residency is not None:
+            out["collective_residency"] = self.collective_residency
         return out
 
 
@@ -121,7 +137,8 @@ def trace_and_check(
     *,
     payload_bytes: Optional[int] = None,
 ) -> TraceRecord:
-    """make_jaxpr twice (retrace guard), run the rule set once."""
+    """make_jaxpr twice (retrace guard), run the rule set once, price the
+    memory envelope once (the liveness interpreter)."""
     closed = jax.make_jaxpr(fn)(*args)
     h1 = jaxpr_hash(closed)
     h2 = jaxpr_hash(jax.make_jaxpr(fn)(*args))
@@ -136,6 +153,15 @@ def trace_and_check(
                 "would recompile",
             )
         )
+    mem = liveness.analyze(closed)
+    for fail in mem.residency_failures:
+        violations.append(
+            Violation(
+                R_PEAK_BYTES,
+                label,
+                f"collective operand-residency failure: {fail}",
+            )
+        )
     return TraceRecord(
         label=label,
         violations=violations,
@@ -143,6 +169,9 @@ def trace_and_check(
         jaxpr_hash=h1,
         payload_bytes=payload_bytes,
         collectives_by_axis=collective_counts_by_axis(closed) or None,
+        peak_bytes=mem.peak_bytes,
+        peak_top=mem.peak_top,
+        collective_residency=mem.collective_residency or None,
     )
 
 
@@ -529,7 +558,13 @@ def audit_resilience_off(*, d: int = 4096) -> List[TraceRecord]:
     return [check_off_identical("resilience:off-identical", make_fn, args, patches)]
 
 
-def audit_fedsim_round(*, d: int = 512) -> List[TraceRecord]:
+def audit_fedsim_round(
+    *,
+    d: int = 512,
+    num_clients: int = 64,
+    clients_per_round: int = 16,
+    label: str = "fedsim:round",
+) -> List[TraceRecord]:
     """The federated round's cross-worker traffic, pinned: the whole round
     (S2C broadcast compression, in-step stratified cohort sampling, vmapped
     client local-train + uplink compression, bank scatter, server update)
@@ -537,7 +572,11 @@ def audit_fedsim_round(*, d: int = 512) -> List[TraceRecord]:
     count, checksum failures) — and the operand bytes of that psum are
     exactly 4*(param_elements + 6) B/worker. Codec count pins TWO top-k
     selections: one S2C delta encode + one vmapped C2S client encode (the
-    cohort shares a single traced selection, however many clients run)."""
+    cohort shares a single traced selection, however many clients run).
+
+    num_clients/clients_per_round are parametrized so the liveness tests can
+    show the residual-bank peak scales with the population N, not the cohort.
+    """
     import optax
 
     from deepreduce_tpu.fedsim.sim import FedSim, synthetic_linear_problem
@@ -546,8 +585,8 @@ def audit_fedsim_round(*, d: int = 512) -> List[TraceRecord]:
     cfg = DeepReduceConfig(
         memory="residual",
         fed=True,
-        fed_num_clients=64,
-        fed_clients_per_round=16,
+        fed_num_clients=num_clients,
+        fed_clients_per_round=clients_per_round,
         fed_local_steps=2,
         **_FLAGSHIP,
     )
@@ -576,7 +615,7 @@ def audit_fedsim_round(*, d: int = 512) -> List[TraceRecord]:
         _sds((2,), jnp.uint32),  # round key
     )
     ctx = AuditContext(
-        label="fedsim:round",
+        label=label,
         allow_callbacks=False,
         expect_collectives={"psum": 1},
         wire_mode="collective",
@@ -585,7 +624,7 @@ def audit_fedsim_round(*, d: int = 512) -> List[TraceRecord]:
         expect_codec_invocations=2,
         require_key_lineage=True,
     )
-    return [trace_and_check("fedsim:round", fn, args, ctx, payload_bytes=pb)]
+    return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
 
 
 def audit_fedsim_async_round(*, d: int = 512) -> List[TraceRecord]:
@@ -1497,3 +1536,28 @@ def audit_all(quick: bool = False) -> Tuple[List[TraceRecord], List[Violation]]:
             )
     violations = [v for r in records for v in r.violations]
     return records, violations
+
+
+def peak_budget_violations(
+    records: List[TraceRecord], budgets: Dict[str, int]
+) -> List[Violation]:
+    """jx-peak-bytes budget gate: each fresh trace's modeled peak must equal
+    the committed per-trace byte budget. Labels absent from the baseline
+    (new traces) and records without a peak (skipped / digest-only) bootstrap
+    silently — the write that follows commits them."""
+    out: List[Violation] = []
+    for rec in records:
+        if rec.peak_bytes is None or rec.label not in budgets:
+            continue
+        want = budgets[rec.label]
+        if rec.peak_bytes != want:
+            out.append(
+                Violation(
+                    R_PEAK_BYTES,
+                    rec.label,
+                    f"peak live bytes drifted from the committed budget: "
+                    f"modeled {rec.peak_bytes} B vs committed {want} B "
+                    f"(re-baseline deliberately with --update)",
+                )
+            )
+    return out

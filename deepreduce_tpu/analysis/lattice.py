@@ -426,9 +426,15 @@ def probe_partition(cell: Dict[str, str]):
     return ("legal", cfg, kw)
 
 
-def probe_cell(cell: Dict[str, str], memo: Dict[str, Tuple[str, Any]]):
+def probe_cell(
+    cell: Dict[str, str],
+    memo: Dict[str, Tuple[str, Any]],
+    stats: Optional[Dict[str, int]] = None,
+):
     """Full probe of one cell: partition, then (for legal cells) build and
     trace through the cell's harness, memoized on the trace fingerprint.
+    When `stats` is given, `cache_hits` counts legal cells answered from
+    the fingerprint memo without tracing.
 
     Returns a cell entry dict plus (for legal cells) the (label, record)
     pair. Construction-stage ConfigError/ValueError becomes a 'build'
@@ -446,6 +452,8 @@ def probe_cell(cell: Dict[str, str], memo: Dict[str, Tuple[str, Any]]):
     harness = _harness_name(cell)
     fp = trace_fingerprint(kw, harness)
     if fp in memo:
+        if stats is not None:
+            stats["cache_hits"] = stats.get("cache_hits", 0) + 1
         label, rec = memo[fp]
         return ({"status": "legal", "trace": label}, (label, rec))
     label = f"lat:{fp[:12]}"
@@ -475,11 +483,18 @@ def probe_cell(cell: Dict[str, str], memo: Dict[str, Tuple[str, Any]]):
 # ---------------------------------------------------------------------- #
 
 
-def build_matrix(progress: Optional[Callable[[str], None]] = None) -> Dict[str, Any]:
+def build_matrix(
+    progress: Optional[Callable[[str], None]] = None,
+    stats: Optional[Dict[str, int]] = None,
+) -> Dict[str, Any]:
     """Probe every cell and assemble the MATRIX report: `entries` is the
     deduplicated outcome table (first-encounter order), `cells` maps each
     lattice cell (lexicographic order) to an entry index, `traces` holds
-    one record per distinct traced program."""
+    one record per distinct traced program.
+
+    `stats` (optional, caller-owned) is filled with the audit-cost view —
+    cells probed and fingerprint-memo cache hits. It stays OUT of the
+    report so MATRIX.json never carries run-cost noise."""
     memo: Dict[str, Tuple[str, Any]] = {}
     entries: List[Dict[str, Any]] = []
     entry_index: Dict[str, int] = {}
@@ -489,7 +504,7 @@ def build_matrix(progress: Optional[Callable[[str], None]] = None) -> Dict[str, 
     codeless: List[str] = []
     done = 0
     for cell in iter_cells():
-        entry, traced = probe_cell(cell, memo)
+        entry, traced = probe_cell(cell, memo, stats)
         key = json.dumps(entry, sort_keys=True)
         if key not in entry_index:
             entry_index[key] = len(entries)
@@ -514,6 +529,9 @@ def build_matrix(progress: Optional[Callable[[str], None]] = None) -> Dict[str, 
         if progress is not None and done % 2048 == 0:
             progress(f"{done}/{n_cells()} cells probed, "
                      f"{len(trace_meta)} distinct traces")
+    if stats is not None:
+        stats["cells_probed"] = done
+        stats["distinct_traces"] = len(trace_meta)
     for slug in codeless[:20]:
         violations.append(
             {
@@ -590,9 +608,10 @@ def load_report(path: Path, *, expect_schema: str = SCHEMA) -> Dict[str, Any]:
 def compare_matrix(
     baseline: Dict[str, Any], fresh: Dict[str, Any], *, limit: int = 25
 ) -> List[str]:
-    """Cell-by-cell legality + trace-hash drift between a committed
-    baseline and a fresh build. Any returned diff means the legality
-    surface or a traced program changed without a deliberate re-baseline."""
+    """Cell-by-cell legality + trace-hash + peak-byte drift between a
+    committed baseline and a fresh build. Any returned diff means the
+    legality surface, a traced program, or a cell's memory envelope
+    changed without a deliberate re-baseline."""
     diffs: List[str] = []
     if baseline.get("axes") != fresh.get("axes"):
         return ["axes changed — the lattice itself moved; re-baseline deliberately"]
@@ -603,9 +622,10 @@ def compare_matrix(
         for idx in report["cells"]:
             e = entries[idx]
             if e["status"] == "legal":
-                yield ("legal", None, traces[e["trace"]]["jaxpr_hash"])
+                t = traces[e["trace"]]
+                yield ("legal", None, t["jaxpr_hash"], t.get("peak_bytes"))
             else:
-                yield ("rejected", e.get("reason_code"), None)
+                yield ("rejected", e.get("reason_code"), None, None)
 
     if len(baseline["cells"]) != len(fresh["cells"]):
         return [
@@ -627,9 +647,15 @@ def compare_matrix(
                 f"{_cell_slug(cell)}: reason_code changed "
                 f"{old[1]} -> {new[1]}"
             )
-        else:
+        elif old[2] != new[2]:
             diffs.append(
                 f"{_cell_slug(cell)}: trace hash changed {old[2]} -> {new[2]}"
+            )
+        elif old[3] is not None:
+            # same program hash, different priced peak: the liveness model
+            # itself moved — a collective-budget drift on this legal cell
+            diffs.append(
+                f"{_cell_slug(cell)}: peak bytes changed {old[3]} -> {new[3]}"
             )
     return diffs
 

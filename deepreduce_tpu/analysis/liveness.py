@@ -1,0 +1,331 @@
+"""Donation-aware liveness / peak-HBM interpreter + the dtype-flow rule.
+
+Every headline plan in this repro is about wire, but a route that OOMs HBM
+— or silently double-buffers the [num_clients, ...] residual bank — dies
+before the wire story matters. EQuARX (PAPERS.md) is the precedent:
+compression only wins on TPU when it lives inside XLA's memory envelope.
+This module prices that envelope statically, on the same flattened
+dataflow graph the SPMD rules already walk (dataflow.build_graph), with
+no devices and no compiles:
+
+- ``analyze`` runs an abstract interpretation of buffer lifetimes under
+  the jaxpr's own topological schedule: a value's buffer is born at its
+  defining node, inputs and outputs of a node are simultaneously resident
+  (XLA op semantics), and a buffer dies after its last read — except a
+  DONATED input, which dies exactly at the birth of the output XLA
+  aliases it to (first-fit same-aval matching, mirroring
+  rule_donation_soundness), the in-place-reuse semantics of
+  `donate_argnums`. The report carries the peak live bytes, the top
+  contributing buffers at the peak with provenance (producer primitive +
+  source site), and the live-byte residency at every collective.
+
+- ``rule_dtype_flow`` is a forward dtype-propagation rule over the same
+  trace: no f64/complex128 promotion anywhere, no silent widening of a
+  quantized narrow payload (int8/uint8/int16/uint16/f16/bf16) into f32
+  outside the registered dequant sites (matched on the eqn's user source
+  frame), and every floating top-level output — aggregated gradients,
+  residual/EF leaves — round-trips at the declared f32.
+
+Model limits, stated: opaque control flow (cond/while/scan) is a single
+node — its body's internal scratch is not priced (the decode fori_loop's
+per-trip temporaries are a few leaf-sized buffers, dwarfed by the gathered
+payload and the residual state this auditor exists to pin). XLA's fusion
+can shave transients the model counts; the committed budgets are
+therefore a *model* peak, compared against itself across PRs — exactly
+like the modeled wire in costmodel — not a silicon measurement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepreduce_tpu.analysis import dataflow
+from deepreduce_tpu.analysis.rules import (
+    COLLECTIVE_PRIMS,
+    R_DTYPE_FLOW,
+    AuditContext,
+    Violation,
+    walk_eqns,
+)
+
+try:  # private but stable since 0.3; fail-open (no provenance) without it
+    from jax._src import source_info_util as _siu
+except ImportError:  # pragma: no cover
+    _siu = None
+
+
+def _aval_nbytes(aval: Any) -> int:
+    """Buffer bytes an aval occupies; 0 for unpriceable extended dtypes
+    (PRNG keys, tokens) — they are word-sized bookkeeping, not payload."""
+    if aval is None:
+        return 0
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        return 0
+    try:
+        n = int(math.prod(int(s) for s in shape)) if shape else 1
+    except (TypeError, ValueError):
+        return 0
+    return n * itemsize
+
+
+def _site_of(eqn: Any) -> str:
+    """`file.py:function` of the innermost user frame that emitted an eqn
+    (line numbers deliberately dropped — committed provenance must not
+    churn on unrelated edits). Sources and info-less eqns get '-'."""
+    if eqn is None or _siu is None:
+        return "-"
+    try:
+        fr = _siu.user_frame(eqn.source_info)
+    except Exception:
+        return "-"
+    if fr is None:
+        return "-"
+    fname = fr.file_name.rsplit("/", 1)[-1]
+    return f"{fname}:{fr.function_name}"
+
+
+@dataclasses.dataclass
+class LivenessReport:
+    """The priced memory envelope of one traced program."""
+
+    peak_bytes: int
+    # top contributing buffers at the peak, largest first:
+    # {bytes, prim, shape, dtype, site}
+    peak_top: List[Dict[str, Any]]
+    # max live bytes observed at any collective eqn, per primitive —
+    # the operand-residency envelope each collective must fit inside
+    collective_residency: Dict[str, int]
+    # operand refs of a collective that were NOT live when the collective
+    # fired (a freed/donated buffer fed a collective — in-place reuse gone
+    # wrong); human-readable, empty on sound traces
+    residency_failures: List[str]
+    nodes: int
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "peak_bytes": self.peak_bytes,
+            "peak_top": self.peak_top,
+            "collective_residency": self.collective_residency,
+        }
+
+
+def analyze(closed_jaxpr: Any) -> LivenessReport:
+    """Peak-liveness abstract interpretation over the flattened graph."""
+    g = dataflow.build_graph(closed_jaxpr)
+    n = len(g.nodes)
+
+    # last read per ref; traced outputs stay live through the end
+    last_use: Dict[dataflow.Ref, int] = {}
+    for fe in g.nodes:
+        for r in fe.in_refs:
+            if r[0] != "lit":
+                last_use[r] = fe.idx  # emission order: the max wins
+    for r in g.out_refs:
+        if r[0] != "lit":
+            last_use[r] = n
+
+    # donation: mirror rule_donation_soundness's first-fit same-aval
+    # matching, then free the donated buffer at its alias's birth instead
+    # of at its last read — XLA writes the aliased output into it
+    donated: set = set()
+    free_at_birth: Dict[int, List[dataflow.Ref]] = {}
+    for don in g.donations:
+        claimed: set = set()
+        for _pos, ref, aval in don.donated:
+            if ref[0] == "lit" or ref in donated:
+                continue
+            for j, (oref, oaval) in enumerate(don.out_refs):
+                if j not in claimed and dataflow._aval_eq(aval, oaval):
+                    claimed.add(j)
+                    if oref[0] != "lit" and oref != ref:
+                        free_at_birth.setdefault(oref[0], []).append(ref)
+                        donated.add(ref)
+                    break
+
+    # non-donated refs die after their last read; dead values die at birth
+    free_after: Dict[int, List[dataflow.Ref]] = {}
+    for fe in g.nodes:
+        for pos in range(len(fe.out_avals)):
+            r = (fe.idx, pos)
+            if r in donated:
+                continue
+            when = last_use.get(r, fe.idx)
+            if when < n:
+                free_after.setdefault(when, []).append(r)
+
+    live: Dict[dataflow.Ref, int] = {}
+    cur = peak = 0
+    peak_live: Dict[dataflow.Ref, int] = {}
+    residency: Dict[str, int] = {}
+    failures: List[str] = []
+
+    def free(r: dataflow.Ref) -> None:
+        nonlocal cur
+        b = live.pop(r, None)
+        if b is not None:
+            cur -= b
+
+    for fe in g.nodes:
+        for r in free_at_birth.get(fe.idx, ()):
+            free(r)  # in-place reuse: donated buffer dies as its alias is born
+        for pos, aval in enumerate(fe.out_avals):
+            b = _aval_nbytes(aval)
+            if b:
+                live[(fe.idx, pos)] = b
+                cur += b
+        if cur > peak:
+            peak = cur
+            peak_live = dict(live)
+        if fe.prim in COLLECTIVE_PRIMS:
+            residency[fe.prim] = max(residency.get(fe.prim, 0), cur)
+            for r in fe.in_refs:
+                if r[0] != "lit" and r not in live and _aval_nbytes(
+                    g.nodes[r[0]].out_avals[r[1]]
+                    if r[1] < len(g.nodes[r[0]].out_avals) else None
+                ):
+                    failures.append(
+                        f"{fe.prim}@{fe.idx} reads ref {r} that is no longer "
+                        "resident (freed or donated away before the "
+                        "collective fired)"
+                    )
+        for r in free_after.get(fe.idx, ()):
+            free(r)
+
+    top = sorted(peak_live.items(), key=lambda kv: (-kv[1], kv[0]))[:3]
+    peak_top = []
+    for (idx, pos), b in top:
+        fe = g.nodes[idx]
+        aval = fe.out_avals[pos] if pos < len(fe.out_avals) else None
+        peak_top.append(
+            {
+                "bytes": b,
+                "prim": fe.prim,
+                "shape": list(getattr(aval, "shape", ())),
+                "dtype": str(getattr(aval, "dtype", "?")),
+                "site": _site_of(fe.eqn),
+            }
+        )
+    return LivenessReport(
+        peak_bytes=peak,
+        peak_top=peak_top,
+        collective_residency=residency,
+        residency_failures=failures,
+        nodes=n,
+    )
+
+
+# ---------------------------------------------------------------------- #
+# jx-dtype-flow
+# ---------------------------------------------------------------------- #
+
+# quantized-payload dtypes: widening one of these to f32/f64 re-inflates a
+# compressed representation and must only happen at a registered dequant
+# site. bool is deliberately excluded — mask/flag -> f32 counters are
+# arithmetic, not payload decompression.
+_NARROW = frozenset(
+    {"int8", "uint8", "int16", "uint16", "float16", "bfloat16"}
+)
+_WIDE = frozenset({"float32", "float64"})
+
+# the registered dequant/decode sites, as (file basename, function name)
+# of the innermost user frame that emits the widening convert. Everything
+# that legitimately turns a narrow wire payload back into f32 lives here;
+# a widening convert anywhere else is a silent re-inflation.
+DEQUANT_SITES = frozenset(
+    {
+        ("qar.py", "bucket_dequantize"),  # int8 levels -> f32 (qar + rs quantized)
+        ("qsgd.py", "decode"),  # QSGD codec: int8 levels -> f32
+        ("qsgd.py", "bucket_scale"),  # norm path shared by encode/decode
+        ("sparse_rs.py", "_exchange_adaptive"),  # dense-lane int8 dequant
+        ("sparse_rs.py", "_exchange_quantized"),  # summed int8 levels -> f32
+        ("integer.py", "decode"),  # packed index deltas -> values
+        ("doubleexp.py", "decode"),  # sign/exponent payload -> f32
+        ("packing.py", "unpack_bits"),  # bit-packed wire words -> values
+    }
+)
+
+
+def _is_f64(dt: Any) -> bool:
+    return str(dt) in ("float64", "complex128")
+
+
+def rule_dtype_flow(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
+    """Forward dtype discipline over one traced program (always armed):
+
+    - no promotion to float64/complex128 anywhere (the f64 *presence* rule
+      jx-f64 catches the values; this catches the conversion that minted
+      them, so a planted promotion trips both with distinct stories);
+    - every ``convert_element_type`` widening a quantized narrow dtype
+      (int8/uint8/int16/uint16/f16/bf16) to f32/f64 must be emitted from a
+      registered dequant site (DEQUANT_SITES, matched on the innermost
+      user source frame) — anywhere else it silently re-inflates a
+      compressed payload to dense f32;
+    - every floating top-level output (aggregated gradients, residual/EF
+      leaves) must be exactly f32 — the declared round-trip dtype.
+
+    Source-info matching fails open: an eqn with no user frame (or a jax
+    build without source_info_util) is not flagged, so the rule can never
+    false-positive on synthetic traces."""
+    promotions: List[str] = []
+    rogue: List[str] = []
+    for eqn in walk_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        new_dt = str(eqn.params.get("new_dtype", ""))
+        if _is_f64(new_dt):
+            promotions.append(f"-> {new_dt} at {_site_of(eqn)}")
+            continue
+        src = getattr(eqn.invars[0], "aval", None)
+        old_dt = str(getattr(src, "dtype", ""))
+        if old_dt in _NARROW and new_dt in _WIDE:
+            site = _site_of(eqn)
+            if site == "-":
+                continue  # no provenance — fail open
+            key = tuple(site.split(":", 1))
+            if key not in DEQUANT_SITES:
+                rogue.append(f"{old_dt} -> {new_dt} at {site}")
+    bad_out: List[str] = []
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for pos, ov in enumerate(getattr(inner, "outvars", ())):
+        dt = getattr(getattr(ov, "aval", None), "dtype", None)
+        if dt is None:
+            continue
+        try:
+            npdt = np.dtype(dt)
+        except TypeError:
+            continue  # extended dtypes (PRNG keys) are not wire payloads
+        if npdt.kind in ("f", "c") and npdt != np.dtype(np.float32):
+            bad_out.append(f"output[{pos}] is {npdt}")
+    probs: List[str] = []
+    if promotions:
+        probs.append(
+            f"{len(promotions)} promotion(s) to f64/c128 "
+            f"(first: {promotions[0]})"
+        )
+    if rogue:
+        probs.append(
+            f"{len(rogue)} widening(s) of a quantized payload outside the "
+            f"registered dequant sites (first: {rogue[0]})"
+        )
+    if bad_out:
+        probs.append(
+            f"{len(bad_out)} floating output(s) not f32 "
+            f"(first: {bad_out[0]}) — residual/EF state must round-trip at "
+            "its declared dtype"
+        )
+    if not probs:
+        return []
+    return [Violation(R_DTYPE_FLOW, ctx.label, "; ".join(probs))]
+
+
+DTYPE_RULES = (rule_dtype_flow,)

@@ -64,6 +64,11 @@ R_COLLECTIVE_SCHEDULE = "jx-collective-schedule"
 R_TOKEN_DOMINANCE = "jx-token-dominance"
 R_DONATION = "jx-donation-soundness"
 R_KEY_LINEAGE = "jx-key-lineage"
+# memory rules (analysis/liveness.py): the peak-liveness interpreter's
+# committed byte budget (drift gated against ANALYSIS.json, harness- and
+# CLI-emitted like jx-retrace) and the forward dtype-propagation rule
+R_PEAK_BYTES = "jx-peak-bytes"
+R_DTYPE_FLOW = "jx-dtype-flow"
 
 ALL_RULE_IDS = (
     R_F64,
@@ -82,6 +87,8 @@ ALL_RULE_IDS = (
     R_TOKEN_DOMINANCE,
     R_DONATION,
     R_KEY_LINEAGE,
+    R_PEAK_BYTES,
+    R_DTYPE_FLOW,
 )
 
 # one-line summaries for ``python -m deepreduce_tpu.analysis --list``; tests
@@ -103,6 +110,8 @@ RULE_DESCRIPTIONS = {
     R_TOKEN_DOMINANCE: "streaming barrier token chain orders encode -> all_gather -> decode",
     R_DONATION: "no equation reads a donated input after its aliased output is live",
     R_KEY_LINEAGE: "every PRNG draw's key folds from the step key; no key reuse",
+    R_PEAK_BYTES: "per-trace peak live bytes match the committed budget; collective operands resident",
+    R_DTYPE_FLOW: "no f64 promotion, no out-of-site payload widening, f32 output round-trip",
 }
 
 # sparsifier-selection primitives: every TensorCodec encode lowers its
@@ -249,13 +258,114 @@ def _index_count(eqn: Any) -> int:
     return int(math.prod(int(s) for s in lead)) if lead else 1
 
 
+def _canon_mask(s: str) -> str:
+    return re.sub(r"0x[0-9a-fA-F]+", "0x", s)
+
+
+def _canon_aval(aval: Any) -> str:
+    try:
+        return f"{aval.dtype}{tuple(aval.shape)}"
+    except Exception:
+        return _canon_mask(str(aval))
+
+
+def _canon_const(c: Any) -> str:
+    """Closed-over constants hash by shape/dtype only — their values are
+    trace-time data (hash seeds, offset tables) already pinned by the
+    numeric tests, and repr'ing megabyte arrays into the hash text would
+    be both slow and numpy-print-options-dependent."""
+    try:
+        a = np.asarray(c)
+        return f"const[{a.dtype}{a.shape}]"
+    except Exception:
+        return _canon_mask(repr(type(c)))
+
+
+def _canon_param(v: Any, memo: Dict[int, str]) -> str:
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_canon_param(x, memo) for x in v) + "]"
+    if isinstance(v, dict):
+        items = sorted(v.items(), key=lambda kv: str(kv[0]))
+        return "{" + ",".join(
+            f"{k}:{_canon_param(val, memo)}" for k, val in items
+        ) + "}"
+    if isinstance(v, (set, frozenset)):
+        # set reprs follow per-process string hashing — render sorted
+        return "{" + ",".join(sorted(_canon_mask(repr(x)) for x in v)) + "}"
+    inner = getattr(v, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):  # ClosedJaxpr
+        consts = ",".join(_canon_const(c) for c in getattr(v, "consts", ()))
+        return "{" + _canon_jaxpr(inner, memo) + ";consts=" + consts + "}"
+    if hasattr(v, "eqns"):  # open sub-jaxpr: canonicalize inline
+        return "{" + _canon_jaxpr(v, memo) + "}"
+    return _canon_mask(repr(v))
+
+
+def _canon_jaxpr(jaxpr: Any, memo: Dict[int, str]) -> str:
+    """Deterministic canonical rendering of one (open) jaxpr: vars renamed
+    in first-appearance order, params sorted by key, and every sub-jaxpr
+    rendered INLINE at its point of use with a fresh name scope. Memoized
+    by object identity — the canonical text is context-free, so the same
+    (jit-cache-shared) sub-jaxpr object renders once however many call
+    sites inline it, and two structurally equal jaxprs always render to
+    the same text regardless of which traced first."""
+    got = memo.get(id(jaxpr))
+    if got is not None:
+        return got
+    names: Dict[Any, str] = {}
+
+    def bind(v: Any) -> str:
+        nm = f"v{len(names)}"
+        names[v] = nm
+        return nm
+
+    def rd(v: Any) -> str:
+        if hasattr(v, "val"):  # Literal
+            return _canon_mask(repr(v.val)) + ":" + _canon_aval(v.aval)
+        nm = names.get(v)
+        if nm is not None:
+            return nm
+        return "free:" + _canon_aval(getattr(v, "aval", None))
+
+    lines = [
+        "in=" + ",".join(
+            bind(v) + ":" + _canon_aval(v.aval) for v in jaxpr.invars
+        ),
+        "const=" + ",".join(
+            bind(v) + ":" + _canon_aval(v.aval) for v in jaxpr.constvars
+        ),
+    ]
+    for eqn in jaxpr.eqns:
+        ins = ",".join(rd(v) for v in eqn.invars)
+        params = ",".join(
+            f"{k}={_canon_param(val, memo)}"
+            for k, val in sorted(eqn.params.items(), key=lambda kv: str(kv[0]))
+        )
+        outs = ",".join(
+            bind(ov) + ":" + _canon_aval(ov.aval) for ov in eqn.outvars
+        )
+        lines.append(f"{outs}={eqn.primitive.name}[{params}]({ins})")
+    lines.append("out=" + ",".join(rd(v) for v in jaxpr.outvars))
+    text = "\n".join(lines)
+    memo[id(jaxpr)] = text
+    return text
+
+
 def jaxpr_hash(jaxpr: Any) -> str:
     """Stable content hash of a traced program — two traces of the same
-    step must agree (the retrace/recompile guard). Object addresses inside
-    callback/function reprs (`... at 0x7f...>`) are masked so the hash is
-    also stable across processes and the baseline ANALYSIS.json diffs
-    clean."""
-    text = re.sub(r"0x[0-9a-fA-F]+", "0x", str(jaxpr))
+    step must agree (the retrace/recompile guard), in the same process or
+    across processes, whatever traced before them. Hashing the
+    pretty-printer output proved trace-history-sensitive (its shared-
+    sub-jaxpr hoisting order follows the jit cache), so the hash is taken
+    over a custom canonical rendering instead: first-appearance var
+    renaming, key-sorted params, sub-jaxprs inlined at their use sites,
+    object addresses masked, set-valued params sorted."""
+    consts = getattr(jaxpr, "consts", None)
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    memo: Dict[int, str] = {}
+    text = _canon_jaxpr(inner, memo)
+    if consts:
+        text += "\nconsts=" + ",".join(_canon_const(c) for c in consts)
     return hashlib.sha256(text.encode()).hexdigest()[:16]
 
 
@@ -589,11 +699,11 @@ JAXPR_RULES = (
 
 def run_rules(jaxpr: Any, ctx: AuditContext) -> List[Violation]:
     """Run every jaxpr rule over one traced program — the linear-walk rules
-    above plus the dataflow-graph rules (imported late: dataflow.py imports
-    this module's plumbing)."""
-    from deepreduce_tpu.analysis import dataflow
+    above plus the dataflow-graph and dtype-flow rules (imported late:
+    dataflow.py/liveness.py import this module's plumbing)."""
+    from deepreduce_tpu.analysis import dataflow, liveness
 
     out: List[Violation] = []
-    for rule in JAXPR_RULES + dataflow.DATAFLOW_RULES:
+    for rule in JAXPR_RULES + dataflow.DATAFLOW_RULES + liveness.DTYPE_RULES:
         out.extend(rule(jaxpr, ctx))
     return out

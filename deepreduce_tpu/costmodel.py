@@ -721,6 +721,47 @@ def rs_payload_bytes(mode: str, d: int, W: int, ratio: float, **kw) -> float:
     return float(sum(rs_wire_bytes(mode, d, W, ratio, **kw).values()))
 
 
+def peak_hbm_bytes(
+    route: str,
+    d: int,
+    W: int,
+    *,
+    residual: bool = True,
+    dtype_bytes: int = 4,
+) -> int:
+    """Modeled peak live bytes of one audited exchange trace — the number
+    the liveness interpreter (analysis/liveness.py) computes and
+    jx-peak-bytes commits as the trace's byte budget.
+
+    The audit harness stacks every per-worker operand to ``[W, d]``, so the
+    peak is dominated by the stacked gradient (and, with error-feedback
+    residuals, the stacked residual bank). What rides on top at the peak
+    differs per route:
+
+    - ``fused``: the dense per-worker view sliced out of the stack is still
+      live when the peak lands, plus the i32 step counter;
+    - ``oktopk``: same dense view (no residual bank — the in-collective
+      sparse_rs routes are memory='none');
+    - ``bucketed``: per-bucket views die bucket-by-bucket before the peak,
+      leaving only encode scratch that is O(payload), not O(d) — modeled
+      as zero here, so the estimate is a tight floor.
+
+    tests/test_liveness.py cross-checks these predictions against the
+    static analyzer on the committed fused/bucketed/oktopk traces: model,
+    trace, and budget cannot drift apart (the jx-wire-accounting contract,
+    applied to HBM).
+    """
+    if route not in ("fused", "bucketed", "oktopk"):
+        raise ValueError(f"unknown peak route {route!r}")
+    banks = 2 if residual else 1
+    stacked = banks * dtype_bytes * W * d
+    if route == "fused":
+        return stacked + dtype_bytes * d + dtype_bytes
+    if route == "oktopk":
+        return stacked + dtype_bytes * d
+    return stacked
+
+
 _RING_TIME = {
     "all_gather": allgather_time,
     "all_to_all": all_to_all_time,

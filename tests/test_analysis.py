@@ -145,7 +145,13 @@ def test_f64_mini_codec_caught():
 
     with enable_x64():
         closed = jax.make_jaxpr(bad_encode)(jax.ShapeDtypeStruct((64,), jnp.float32))
-    v = _only(run_rules(closed, AuditContext(label="fixture:f64")), rules.R_F64)
+    # the f64 *presence* rule catches the values; jx-dtype-flow catches the
+    # promotion that minted them — one planted fixture, two distinct stories
+    viols = run_rules(closed, AuditContext(label="fixture:f64"))
+    assert {v.rule for v in viols} == {rules.R_F64, rules.R_DTYPE_FLOW}, [
+        v.to_dict() for v in viols
+    ]
+    v = next(v for v in viols if v.rule == rules.R_F64)
     assert "float64" in v.detail
 
 
@@ -716,7 +722,9 @@ def test_matrix_cli_drift_detection(monkeypatch, tmp_path):
 
     committed = lattice.load_report(_repo_root() / "MATRIX.json")
     monkeypatch.setattr(
-        lattice, "build_matrix", lambda progress=None: copy.deepcopy(committed)
+        lattice,
+        "build_matrix",
+        lambda progress=None, stats=None: copy.deepcopy(committed),
     )
     baseline = tmp_path / "MATRIX.json"
     lattice.write_matrix(committed, baseline)

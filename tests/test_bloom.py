@@ -44,7 +44,12 @@ def test_default_fpr_rule():
     assert meta.fpr == pytest.approx(0.1 * 100 / 10000)
 
 
-@pytest.mark.parametrize("policy", ["leftmost", "random", "p0"])
+# leftmost compiles the scan-based first-k selection (~19s); random/p0 keep
+# the FP-aware agreement property in the quick tier.
+@pytest.mark.parametrize(
+    "policy",
+    [pytest.param("leftmost", marks=pytest.mark.slow), "random", "p0"],
+)
 def test_encode_decode_agree_on_indices(policy):
     g, sp = _make(d=30000)
     meta = bloom.BloomMeta.create(sp.k, sp.dense_size, fpr=0.01, policy=policy)
@@ -190,6 +195,7 @@ def test_prefix_select_exact_large_d():
         assert (np.asarray(idx)[n:] == 0).all()
 
 
+@pytest.mark.slow
 def test_bloom_round_trip_large_d():
     """Encode/decode at larger d: FP-aware agreement (values land at the
     derived indices) on both classic and blocked filters."""

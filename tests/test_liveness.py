@@ -1,0 +1,340 @@
+"""The memory-liveness & precision-flow auditor (analysis/liveness.py):
+donation-aware peak pricing, the jx-peak-bytes budget gate, the
+jx-dtype-flow forward dtype rule, the costmodel.peak_hbm_bytes
+cross-check, and the canonical-hash order-invariance contract — each
+claim proven by a clean/planted pair."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepreduce_tpu import costmodel
+from deepreduce_tpu.analysis import liveness
+from deepreduce_tpu.analysis.jaxpr_audit import (
+    audit_fedsim_async_round,
+    audit_fedsim_multitenant,
+    audit_fedsim_round,
+    audit_specs,
+    peak_budget_violations,
+)
+from deepreduce_tpu.analysis.rules import (
+    ALL_RULE_IDS,
+    R_DTYPE_FLOW,
+    R_PEAK_BYTES,
+    AuditContext,
+)
+
+_CTX = AuditContext(label="fixture")
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _spec_record(label):
+    """Trace one registered audit spec by label."""
+    (rec,) = dict(audit_specs())[label]()
+    return rec
+
+
+# ---------------------------------------------------------------------- #
+# the liveness model: donation semantics + determinism
+# ---------------------------------------------------------------------- #
+
+
+def test_donation_frees_at_aliased_output_birth():
+    """r09's donate_argnums contract, priced: the donated in-place update
+    peaks at ONE buffer (the invar dies the moment its alias is born),
+    the undonated build double-buffers — exactly 2x."""
+    d = 65536
+    donated = jax.jit(lambda w: w * 0.999, donate_argnums=0)
+    undonated = jax.jit(lambda w: w * 0.999)
+    peak_don = liveness.analyze(
+        jax.make_jaxpr(lambda w: donated(w))(_sds((d,)))
+    ).peak_bytes
+    peak_undon = liveness.analyze(
+        jax.make_jaxpr(lambda w: undonated(w))(_sds((d,)))
+    ).peak_bytes
+    assert peak_don == 4 * d
+    assert peak_undon == 2 * peak_don
+
+
+def test_analyze_is_deterministic():
+    closed = jax.make_jaxpr(lambda x: jnp.sum(x * 2.0))(_sds((1024,)))
+    a = liveness.analyze(closed).to_dict()
+    b = liveness.analyze(closed).to_dict()
+    assert a == b
+    assert a["peak_bytes"] > 0
+
+
+def test_undonated_double_buffer_busts_committed_budget():
+    """The planted negative fixture for jx-peak-bytes: commit the donated
+    trace's budget, then audit the undonated double-buffer variant under
+    the same label — the budget gate must fire with the 2x peak."""
+    d = 65536
+    donated = jax.jit(lambda w: w * 0.999, donate_argnums=0)
+    undonated = jax.jit(lambda w: w * 0.999)
+    budget = liveness.analyze(
+        jax.make_jaxpr(lambda w: donated(w))(_sds((d,)))
+    ).peak_bytes
+
+    from deepreduce_tpu.analysis.jaxpr_audit import trace_and_check
+
+    rec = trace_and_check(
+        "fixture:double-buffer",
+        lambda w: undonated(w),
+        (_sds((d,)),),
+        AuditContext(label="fixture:double-buffer"),
+    )
+    assert rec.violations == []  # the trace itself is rule-clean
+    viols = peak_budget_violations([rec], {"fixture:double-buffer": budget})
+    assert len(viols) == 1 and viols[0].rule == R_PEAK_BYTES
+    assert str(rec.peak_bytes) in viols[0].detail
+    # unknown labels and peak-less records bootstrap silently
+    assert peak_budget_violations([rec], {}) == []
+
+
+# ---------------------------------------------------------------------- #
+# fedsim: the residual bank scales with N, not the cohort
+# ---------------------------------------------------------------------- #
+
+
+def test_fedsim_bank_peak_scales_with_population_not_cohort():
+    d, n = 256, 64
+    base = audit_fedsim_round(d=d, num_clients=n)[0]
+    big_n = audit_fedsim_round(
+        d=d, num_clients=2 * n, label="fedsim:round-n128"
+    )[0]
+    big_c = audit_fedsim_round(
+        d=d, clients_per_round=32, label="fedsim:round-c32"
+    )[0]
+    assert not any(r.violations for r in (base, big_n, big_c))
+
+    # the bank is the single biggest buffer at the peak and is exactly
+    # [num_clients, d] f32 — resident ONCE (no double-buffering)
+    top = base.peak_top[0]
+    assert top["shape"] == [n, d] and top["bytes"] == 4 * n * d
+
+    # doubling the population grows the peak by exactly the bank delta...
+    bank_delta = 4 * n * d
+    delta_n = big_n.peak_bytes - base.peak_bytes
+    assert abs(delta_n - bank_delta) <= 0.05 * bank_delta
+    # ...while doubling the cohort adds only vmapped working set, strictly
+    # less than bank-scale growth
+    delta_c = big_c.peak_bytes - base.peak_bytes
+    assert delta_c < delta_n
+
+
+def test_multitenant_t1_peak_matches_single_tenant():
+    """Stacking T=1 population through the vmapped tick prices the same
+    envelope as the plain async tick: byte-identical dominant buffers
+    (modulo the leading [1] tenant dim), peak within 5%."""
+    single = audit_fedsim_async_round()[0]
+    (t1,) = audit_fedsim_multitenant(tenants=(1,))
+    assert single.violations == [] and t1.violations == []
+    assert [b["bytes"] for b in t1.peak_top] == [
+        b["bytes"] for b in single.peak_top
+    ]
+    assert t1.peak_bytes == pytest.approx(single.peak_bytes, rel=0.05)
+
+
+# ---------------------------------------------------------------------- #
+# costmodel.peak_hbm_bytes cross-check: model == analyzer
+# ---------------------------------------------------------------------- #
+
+
+def test_costmodel_peak_matches_analyzer():
+    fused = _spec_record("exchange:fused-loop")
+    assert fused.peak_bytes == costmodel.peak_hbm_bytes("fused", 4096, 8)
+
+    oktopk = _spec_record("exchange:sparse_rs-oktopk")
+    assert oktopk.peak_bytes == costmodel.peak_hbm_bytes(
+        "oktopk", 4096, 8, residual=False
+    )
+
+    bucketed = _spec_record("exchange:bucketed-loop")
+    d_total = 3000 + 900 + 700 + 300 + 150 + 50  # _BUCKET_LEAVES census
+    est = costmodel.peak_hbm_bytes("bucketed", d_total, 8)
+    # the bucketed floor ignores O(payload) encode scratch — tight to <1%
+    assert est <= bucketed.peak_bytes
+    assert bucketed.peak_bytes == pytest.approx(est, rel=0.01)
+
+    with pytest.raises(ValueError):
+        costmodel.peak_hbm_bytes("ring", 4096, 8)
+
+
+# ---------------------------------------------------------------------- #
+# jx-dtype-flow: accept/reject pairs
+# ---------------------------------------------------------------------- #
+
+
+def test_dtype_flow_clean_f32_program():
+    closed = jax.make_jaxpr(lambda x: x * 2.0 + 1.0)(_sds((64,)))
+    assert liveness.rule_dtype_flow(closed, _CTX) == []
+
+
+def test_dtype_flow_rejects_f64_promotion():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        closed = jax.make_jaxpr(lambda x: x.astype(jnp.float64) * 2.0)(
+            _sds((64,))
+        )
+    (v,) = liveness.rule_dtype_flow(closed, _CTX)
+    assert v.rule == R_DTYPE_FLOW
+    assert "promotion" in v.detail and "f64" in v.detail
+
+
+def _rogue_dequant(x):
+    # a silent int8 -> f32 re-inflation OUTSIDE the registered sites
+    return x.astype(jnp.float32) * 2.0
+
+
+def test_dtype_flow_rejects_out_of_site_dequant():
+    closed = jax.make_jaxpr(_rogue_dequant)(_sds((64,), jnp.int8))
+    (v,) = liveness.rule_dtype_flow(closed, _CTX)
+    assert v.rule == R_DTYPE_FLOW
+    assert "dequant" in v.detail
+    assert "test_liveness.py:_rogue_dequant" in v.detail
+
+
+def test_dtype_flow_accepts_registered_dequant_site():
+    from deepreduce_tpu import qar
+
+    closed = jax.make_jaxpr(
+        lambda lv, nm: qar.bucket_dequantize(lv, nm, 127, 64)
+    )(_sds((256,), jnp.int8), _sds((4,)))
+    assert liveness.rule_dtype_flow(closed, _CTX) == []
+    assert ("qar.py", "bucket_dequantize") in liveness.DEQUANT_SITES
+
+
+def test_dtype_flow_rejects_non_f32_output():
+    closed = jax.make_jaxpr(lambda x: x.astype(jnp.float16))(_sds((64,)))
+    (v,) = liveness.rule_dtype_flow(closed, _CTX)
+    assert v.rule == R_DTYPE_FLOW
+    assert "round-trip" in v.detail
+
+
+def test_new_rules_registered():
+    assert R_PEAK_BYTES in ALL_RULE_IDS and R_DTYPE_FLOW in ALL_RULE_IDS
+
+
+# ---------------------------------------------------------------------- #
+# CLI: budget-drift exit code, --update re-baseline, --only gating, mem
+# ---------------------------------------------------------------------- #
+
+
+def _fake_record(label, peak):
+    from deepreduce_tpu.analysis.jaxpr_audit import TraceRecord
+
+    return TraceRecord(
+        label=label, violations=[], collectives={}, jaxpr_hash="ab" * 8,
+        peak_bytes=peak, peak_top=[], collective_residency=None,
+    )
+
+
+def test_cli_budget_drift_exit_and_update(monkeypatch, tmp_path):
+    import deepreduce_tpu.analysis.__main__ as cli
+    import deepreduce_tpu.analysis.ast_lint as al
+    import deepreduce_tpu.analysis.jaxpr_audit as ja
+
+    out = tmp_path / "ANALYSIS.json"
+    monkeypatch.setattr(al, "lint_repo", lambda root=None: [])
+    monkeypatch.setattr(
+        ja, "audit_all", lambda quick=False: ([_fake_record("t", 100)], [])
+    )
+    # no baseline: bootstrap silently, commit the budget
+    assert cli.main(["audit", "--out", str(out)]) == 0
+    committed = json.loads(out.read_text())
+    assert committed["jaxpr_audit"]["traces"][0]["peak_bytes"] == 100
+
+    # drift: exit 1 and the committed baseline is NOT overwritten
+    monkeypatch.setattr(
+        ja, "audit_all", lambda quick=False: ([_fake_record("t", 200)], [])
+    )
+    assert cli.main(["audit", "--out", str(out)]) == 1
+    assert json.loads(out.read_text()) == committed
+
+    # --only on an unrelated rule ungates the exit code (report still
+    # withheld), --only jx-peak-bytes gates it
+    assert cli.main(
+        ["audit", "--out", str(out), "--only", "jx-dtype-flow"]
+    ) == 0
+    assert cli.main(
+        ["audit", "--out", str(out),
+         "--only", "jx-peak-bytes,jx-dtype-flow"]
+    ) == 1
+
+    # deliberate re-baseline
+    assert cli.main(["audit", "--out", str(out), "--update"]) == 0
+    assert json.loads(out.read_text())["jaxpr_audit"]["traces"][0][
+        "peak_bytes"
+    ] == 200
+    assert cli.main(["audit", "--out", str(out)]) == 0
+
+
+def test_cli_mem_gates_on_violations(monkeypatch, capsys):
+    import deepreduce_tpu.analysis.__main__ as cli
+    import deepreduce_tpu.analysis.jaxpr_audit as ja
+    from deepreduce_tpu.analysis.rules import Violation
+
+    clean = _fake_record("exchange:fused-loop", 64)
+    clean.peak_top = [
+        {"bytes": 64, "prim": "add", "shape": [16], "dtype": "float32",
+         "site": "comm.py:decode"}
+    ]
+    monkeypatch.setattr(
+        ja, "audit_specs",
+        lambda quick=False: [("exchange:fused-loop", lambda: [clean])],
+    )
+    assert cli.main(["mem"]) == 0
+    out = capsys.readouterr().out
+    assert "exchange:fused-loop" in out and "comm.py:decode" in out
+
+    bad = _fake_record("exchange:fused-loop", 64)
+    bad.violations = [Violation(R_PEAK_BYTES, "exchange:fused-loop", "boom")]
+    monkeypatch.setattr(
+        ja, "audit_specs",
+        lambda quick=False: [("exchange:fused-loop", lambda: [bad])],
+    )
+    assert cli.main(["mem"]) == 1
+    # --only on an unrelated rule ungates
+    assert cli.main(["mem", "--only", "jx-dtype-flow"]) == 0
+
+
+# ---------------------------------------------------------------------- #
+# canonical hash: trace-history order invariance (subprocess pair)
+# ---------------------------------------------------------------------- #
+
+_ORDER_SCRIPT = """
+import sys
+from deepreduce_tpu.analysis.jaxpr_audit import audit_specs
+specs = dict(audit_specs())
+for label in sys.argv[1].split(","):
+    (rec,) = specs[label]()
+    print(f"{rec.label}={rec.jaxpr_hash}")
+"""
+
+
+def _hashes_in_order(order):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _ORDER_SCRIPT, order],
+        capture_output=True, text=True, env=env, check=True,
+    ).stdout
+    return dict(line.split("=", 1) for line in out.split() if "=" in line)
+
+def test_jaxpr_hash_is_trace_order_invariant():
+    """The r21 bug, fenced: hashing the pretty-printer output made a
+    trace's hash depend on which programs were traced before it (shared
+    sub-jaxpr hoisting order). The canonical renderer must give identical
+    hashes whichever order the audits run in — proven across processes."""
+    a = _hashes_in_order("exchange:fused-loop,exchange:bucketed-loop")
+    b = _hashes_in_order("exchange:bucketed-loop,exchange:fused-loop")
+    assert a == b
+    assert len(a) == 2 and all(len(h) == 16 for h in a.values())

@@ -1,4 +1,4 @@
-.PHONY: analyze analyze-quick matrix-check memcheck test test-quick telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check
+.PHONY: analyze analyze-quick matrix-check memcheck test test-quick telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check slo-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
@@ -7,7 +7,7 @@
 # (chaos-check), the federated round smoke (fedsim-check) and the
 # composition-lattice legality matrix (matrix-check) so none of those
 # paths can rot while the gate stays green.
-analyze: memcheck matrix-check telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check
+analyze: memcheck matrix-check telemetry-check chaos-check fedsim-check fedasync-check fedmt-check slo-check ctrl-check overlap-check calibrate-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
 
 # memory-liveness gate: the donation-aware liveness interpreter over the
@@ -78,6 +78,21 @@ fedmt-check:
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.fedsim --platform cpu check \
 		--tenants 2 --rounds 8 --track_dir $(FEDMT_CHECK_DIR)
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry summary $(FEDMT_CHECK_DIR)/mt-check
+
+# SLO health-plane smoke: the async churn+chaos check run with the
+# in-driver HealthMonitor armed (--slo) — asserts the run ends healthy,
+# health.jsonl is schema-valid and matches the monitor's event stream,
+# the post-checkpoint health tail replays BITWISE on resume, and the
+# staleness p95 that feeds the monitor comes from the on-device
+# histogram; then `telemetry slo` re-evaluates the recorded report
+# stream against the committed slo.json spec and exit-gates on BREACH.
+SLO_CHECK_DIR := /tmp/drtpu_slo_check
+slo-check:
+	rm -rf $(SLO_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.fedsim --platform cpu check \
+		--async --slo --rounds 8 --track_dir $(SLO_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry slo \
+		$(SLO_CHECK_DIR)/check --spec slo.json
 
 # resilience smoke: a short 8-worker CPU-mesh train under a FaultPlan drop
 # schedule + wire corruption with payload checksums — asserts finite,

@@ -631,8 +631,13 @@ def audit_fedsim_async_round(*, d: int = 512) -> List[TraceRecord]:
     """The asynchronous buffered tick keeps the round's collective contract:
     however deep the overlap ring, however the K-threshold buffered apply
     gates the server update, the whole ingest tick is still exactly ONE
-    fused psum — the sync tuple plus the staleness-weight mass, so the
-    operand bytes are exactly 4*(param_elements + 7) B/worker. Codec count
+    fused psum — the sync tuple plus the staleness-weight mass plus the
+    D-level staleness histogram (r23 health plane: accepted contributions
+    counted per staleness level, on device), so the operand bytes are
+    exactly 4*(param_elements + 7 + D) B/worker. This is a DELIBERATE
+    re-pin from the r20 law 4*(n+7): the histogram members ride the same
+    fused psum — the collective count stays ONE — and only its operand
+    bytes grow, by the 4*D B/worker the D counters cost. Codec count
     stays at TWO (pending-gated S2C delta encode is staged exactly once;
     the vmapped C2S client encode is shared by the cohort); the latency
     draw and buffered apply add no collectives because staleness is drawn
@@ -685,7 +690,8 @@ def audit_fedsim_async_round(*, d: int = 512) -> List[TraceRecord]:
         for p in jax.tree_util.tree_leaves(params_sds)
     )
     # psum tuple = param-leaf update sums + wire4 + nlive + nfail + wsum
-    pb = 4 * (n_elems + 7)
+    # + D staleness-histogram counters (r23 re-pin: +4*D B/worker)
+    pb = 4 * (n_elems + 7 + D)
     args = (
         params_sds,  # params (replicated)
         params_sds,  # w_ref (replicated)
@@ -715,13 +721,17 @@ def audit_fedsim_multitenant(
     sizes: stacking T async populations through the one vmapped tick keeps
     EXACTLY ONE psum — the collective count is independent of T — while
     the psum tuple's operand bytes grow exactly linearly in T,
-    4*(T*(n_elems+3) + 4) B/worker: the param-leaf update sums and the
-    nlive/nfail/wsum scalars gain a leading tenant dim, while the four
-    wire-accounting scalars are shape-static and tenant-invariant, so vmap
-    leaves them unbatched. Codec count stays at TWO: the vmap over tenants
-    batches the S2C delta encode and the shared C2S client encode instead
-    of re-staging them per tenant — the whole point of serving T
-    populations from one compiled program."""
+    4*(T*(n_elems+3+D) + 4) B/worker: the param-leaf update sums, the
+    nlive/nfail/wsum scalars, AND the D-level staleness histogram (r23
+    health plane — per-tenant tail percentiles, so its counters batch
+    like the other data-dependent members) gain a leading tenant dim,
+    while the four wire-accounting scalars are shape-static and
+    tenant-invariant, so vmap leaves them unbatched. This is a DELIBERATE
+    re-pin from the r21 law 4*(T*(n+3)+4): the histogram adds 4*T*D
+    B/worker and nothing else moves. Codec count stays at TWO: the vmap
+    over tenants batches the S2C delta encode and the shared C2S client
+    encode instead of re-staging them per tenant — the whole point of
+    serving T populations from one compiled program."""
     import optax
 
     from deepreduce_tpu.fedsim.sim import (
@@ -778,9 +788,10 @@ def audit_fedsim_multitenant(
             for p in jax.tree_util.tree_leaves(params_sds)
         )
         # batched members (leading tenant dim): param-leaf update sums +
-        # nlive + nfail + wsum; unbatched: the 4 tenant-invariant wire
-        # scalars. Linear in T, one psum regardless of T.
-        pb = 4 * (T * (n_elems + 3) + 4)
+        # nlive + nfail + wsum + D staleness-histogram counters (r23
+        # re-pin: +4*T*D B/worker); unbatched: the 4 tenant-invariant
+        # wire scalars. Linear in T, one psum regardless of T.
+        pb = 4 * (T * (n_elems + 3 + D) + 4)
         args = (
             stacked_sds,  # params [T, ...] (replicated)
             stacked_sds,  # w_ref [T, ...] (replicated)

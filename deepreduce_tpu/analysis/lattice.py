@@ -272,15 +272,17 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
     hardcodes the flagship config): one jitted shard_map round over the
     client-sharded residual bank, wire accounting pinned to the single
     fused psum's 4*(param_elements + 6) B/worker — or, on the fed_async=on
-    plane, the buffered ingest tick's 4*(param_elements + 7) (the
-    staleness-weight mass rides the same fused tuple).
+    plane, the buffered ingest tick's 4*(param_elements + 7 + D) (the
+    staleness-weight mass AND the D-level staleness histogram — the r23
+    health plane's on-device tail counters — ride the same fused tuple;
+    a deliberate re-pin from the r20 law 4*(n+7)).
 
     On the fed_mt=on plane the T=2 fleet runs through the one vmapped
     tick: still exactly one psum, operand bytes linear in T. vmap
     batches the param-leaf sums plus the tenant-varying tuple scalars
-    (nlive/nfail, +wsum when async, +2 wire scalars when the checksum
-    makes wire accounting data-dependent) and leaves the shape-static
-    wire scalars unbatched."""
+    (nlive/nfail, +wsum and the D histogram counters when async, +2 wire
+    scalars when the checksum makes wire accounting data-dependent) and
+    leaves the shape-static wire scalars unbatched."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -314,7 +316,12 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
     T = int(getattr(cfg, "fed_tenants", 0) or 0)
     if T >= 1:
         data_dep_wire = bool(cfg.payload_checksum or cfg.chaos_corrupt_rate)
-        s_batched = (3 if cfg.fed_async else 2) + (2 if data_dep_wire else 0)
+        # async batched members gain wsum + the D staleness-histogram
+        # counters (r23 re-pin: +4*T*D B/worker)
+        D_mt = len(fs.mt_latency[0]) if cfg.fed_async else 0
+        s_batched = (
+            (3 + D_mt if cfg.fed_async else 2) + (2 if data_dep_wire else 0)
+        )
         s_static = 2 if data_dep_wire else 4
         pb = 4 * (T * (n_elems + s_batched) + s_static)
         stacked_sds = tmap(lambda p: ja._sds((T,) + p.shape, p.dtype), params_sds)
@@ -367,7 +374,11 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
             require_key_lineage=True,
         )
         return ja.trace_and_check(label, fn, args, ctx, payload_bytes=pb)
-    pb = 4 * (n_elems + 6 + (1 if cfg.fed_async else 0))
+    # async adds wsum + the D staleness-histogram counters to the fused
+    # tuple (r23 re-pin: the old law was n_elems + 7 when async)
+    pb = 4 * (
+        n_elems + 6 + ((1 + len(fs.latency_probs)) if cfg.fed_async else 0)
+    )
     args = (
         params_sds,
         params_sds,

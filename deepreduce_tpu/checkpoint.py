@@ -39,8 +39,13 @@ from deepreduce_tpu.resilience.retry import retry_io
 from deepreduce_tpu.train import TrainState  # noqa: F401  (re-export: templates)
 
 # config fields that change what is *observed*, never what is *computed* —
-# a checkpoint written with telemetry off must restore under telemetry on
-_OBSERVABILITY_FIELDS = frozenset({"telemetry", "telemetry_every", "micro_benchmark"})
+# a checkpoint written with telemetry off must restore under telemetry on,
+# and turning the SLO health plane on/off (a host-side monitor over the
+# already-logged report stream) must never invalidate a restore
+_OBSERVABILITY_FIELDS = frozenset({
+    "telemetry", "telemetry_every", "micro_benchmark",
+    "slo_spec", "slo_window", "slo_hysteresis",
+})
 
 
 def config_fingerprint(cfg: DeepReduceConfig) -> str:

@@ -110,6 +110,18 @@ REASON_CODES: Dict[str, str] = {
     "fed-mt-cohort-syntax":
         "fed_mt_cohort failed the per-tenant list parse or has a size "
         "outside [1, fed_clients_per_round]",
+    "slo-needs-fed": "slo_spec without the federated serving path",
+    "slo-knobs-disengaged": "slo_* override knob(s) without slo_spec",
+    "slo-window-range": "slo_window < 0",
+    "slo-hysteresis-range": "slo_hysteresis < 0",
+    # SLO spec-file rejections (slo/spec.py): the spec parser raises these
+    # so a typo'd slo.json fails loudly instead of silently monitoring
+    # nothing
+    "slo-spec-syntax": "SLO spec file failed SLOSpec parse",
+    "slo-spec-unknown-target": "SLO spec target not in slo.spec.TARGET_KEYS",
+    "slo-spec-target-range": "SLO spec target value outside its legal range",
+    "slo-spec-window-range": "SLO spec window/hysteresis tick count invalid",
+    "slo-spec-tenant-override": "SLO spec per-tenant override malformed",
     "ctrl-knobs-disengaged": "ctrl_* knob(s) without ctrl=True",
     "ctrl-needs-telemetry": "ctrl=True without telemetry=True",
     "ctrl-needs-compressor": "ctrl=True with compressor='none'",
@@ -511,6 +523,20 @@ class DeepReduceConfig:
     # 'auto' selector to consume it — a fully explicit plan has nothing for
     # the profile to re-select.
     profile: Optional[str] = None
+    # SLO health plane (deepreduce_tpu.slo): path to a schema-validated
+    # SLOSpec JSON. The monitor it configures is host-side only — a pure
+    # function of the telemetry report stream, exactly like the r14
+    # controller — so the traced tick programs are byte-identical with or
+    # without it; the on-device half (the staleness histogram riding the
+    # one fused psum) is keyed off telemetry+fed_async, not this knob.
+    # None (default) = no health plane.
+    slo_spec: Optional[str] = None
+    # rolling-window override (ticks) applied over the spec file's
+    # window_ticks; 0 (default) keeps the spec value
+    slo_window: int = 0
+    # hysteresis override (consecutive same-direction evaluations before
+    # a state transition); 0 (default) keeps the spec value
+    slo_hysteresis: int = 0
 
     # the documented enumerations (comments above + codecs/registry.py).
     # __post_init__ checks against these so a typo like
@@ -1088,6 +1114,38 @@ class DeepReduceConfig:
                     f"fed_mt_cohort={self.fed_mt_cohort!r}: every per-tenant "
                     "effective cohort must be an integer in [1, "
                     f"fed_clients_per_round={self.fed_clients_per_round}]"
+                )
+        # --- SLO health plane: host-side monitor over the fed tick stream --
+        slo_engaged = [
+            name for name in ("slo_window", "slo_hysteresis")
+            if getattr(self, name) != 0
+        ]
+        if slo_engaged and self.slo_spec is None:
+            raise ConfigError(
+                "slo-knobs-disengaged",
+                f"{', '.join(slo_engaged)} override the SLO spec windows "
+                "and would be silently ignored with slo_spec=None — set "
+                "slo_spec (or drop the knob(s))"
+            )
+        if self.slo_spec is not None:
+            if not self.fed:
+                raise ConfigError(
+                    "slo-needs-fed",
+                    "slo_spec configures the serving health monitor, which "
+                    "consumes the federated tick report stream — it has "
+                    "nothing to watch with fed=False"
+                )
+            if self.slo_window < 0:
+                raise ConfigError(
+                    "slo-window-range",
+                    f"slo_window must be >= 0 (0 keeps the spec value), "
+                    f"got {self.slo_window}"
+                )
+            if self.slo_hysteresis < 0:
+                raise ConfigError(
+                    "slo-hysteresis-range",
+                    f"slo_hysteresis must be >= 0 (0 keeps the spec "
+                    f"value), got {self.slo_hysteresis}"
                 )
         # --- adaptive controller: loud failure for silently-ignored knobs ---
         ctrl_engaged = [

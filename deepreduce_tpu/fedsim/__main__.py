@@ -44,6 +44,63 @@ def _build_cfg(**overrides):
     return DeepReduceConfig(**base)
 
 
+def _default_slo_spec(cfg):
+    """The embedded churn+chaos smoke spec for `check --slo` without
+    --slo_spec: targets the smoke MUST satisfy (it ends healthy), sized
+    to the check's known geometry — chaos corrupts ~20% of uplinks
+    against a 50% error budget, the 3-level latency draw keeps p95 under
+    the distribution depth, and the buffer never holds more than a few
+    cohorts between applies."""
+    targets = {
+        "min_clients_per_round": 1.0,
+        "checksum_failure_budget": 0.5,
+        "convergence_band": 2.0,
+        "convergence_residency_min": 0.5,
+    }
+    if cfg.fed_async:
+        from deepreduce_tpu.fedsim.round import parse_latency
+
+        depth = len(parse_latency(cfg.fed_async_latency))
+        targets["staleness_p95_max"] = float(depth)
+        targets["buffer_fill_max"] = float(4 * cfg.fed_async_k)
+    return {
+        "version": 1,
+        "window_ticks": 4,
+        "fast_window_ticks": 2,
+        "slow_window_ticks": 6,
+        "hysteresis_ticks": 2,
+        "targets": targets,
+    }
+
+
+def _slo_monitor(args, cfg, run_dir):
+    """(monitor, spec) for `check --slo`, logging to RUN/health.jsonl."""
+    from deepreduce_tpu.slo import HealthLog, HealthMonitor, SLOSpec
+
+    if getattr(args, "slo_spec", ""):
+        spec = SLOSpec.load(args.slo_spec)
+    else:
+        spec = SLOSpec.from_dict(_default_slo_spec(cfg))
+    log = HealthLog(f"{run_dir}/health.jsonl")
+    return HealthMonitor(spec, log=log), spec
+
+
+def _slo_report(rec, w_rel_err):
+    """The deterministic per-tick report the monitor consumes: only
+    fields that are pure functions of (state, key) — never wall-clock —
+    so the kill/resume replay regenerates them bitwise."""
+    rep = {
+        "clients": rec.get("clients"),
+        "checksum_failures": rec.get("checksum_failures"),
+        "buffer_fill": rec.get("buffer_fill"),
+        "w_rel_err": w_rel_err,
+    }
+    hist = rec.get("staleness_hist")
+    if isinstance(hist, list):
+        rep["staleness_hist"] = hist
+    return rep
+
+
 def _run_check(args):
     import jax
     import jax.numpy as jnp
@@ -98,6 +155,19 @@ def _run_check(args):
         tags=["fedsim", "check"],
     )
 
+    w_true = jax.random.normal(jax.random.PRNGKey(42), (dim,))
+
+    def _w_rel(params):
+        return float(
+            jnp.linalg.norm(params["w"] - w_true) / jnp.linalg.norm(w_true)
+        )
+
+    monitor = spec = None
+    saved_slo_state = None
+    slo_events_at_save = 0
+    if args.slo:
+        monitor, spec = _slo_monitor(args, cfg, run.dir)
+
     rounds_hist = []
     ckpt_path = f"{args.track_dir}/ckpt"
     mid = args.rounds // 2
@@ -106,9 +176,19 @@ def _run_check(args):
     saved_stale_sum = None
     for r in range(args.rounds):
         state, m = fs.step(state, jax.random.fold_in(key, r))
-        rec = {k: float(v) for k, v in m.items()}
+        rec = {}
+        for k, v in m.items():
+            arr = np.asarray(v)
+            # vector metrics (the async on-device staleness histogram) log
+            # as lists; scalars stay plain floats
+            rec[k] = (
+                [float(x) for x in arr.reshape(-1)] if arr.ndim else float(arr)
+            )
+        rec["w_rel_err"] = _w_rel(state.params)
         rounds_hist.append(rec)
         run.log({"round": r, **rec})
+        if monitor is not None:
+            monitor.observe(r, _slo_report(rec, rec["w_rel_err"]))
         if args.use_async:
             # save at the first mid-run tick where the buffer is MID-FILL
             # (partially filled, staleness counters nonzero) — the apply
@@ -127,6 +207,16 @@ def _run_check(args):
             if state.buffer is not None:
                 saved_buffer_fill = float(state.buffer.count)
                 saved_stale_sum = float(state.buffer.stale_sum)
+            if monitor is not None:
+                # the monitor state rides the checkpoint as a plain-JSON
+                # sidecar: the resumed monitor must replay the health
+                # event tail bitwise from the re-executed tick reports
+                saved_slo_state = json.dumps(
+                    monitor.state_dict(), sort_keys=True
+                )
+                slo_events_at_save = len(monitor.events)
+                with open(f"{args.track_dir}/slo_state.json", "w") as f:
+                    f.write(saved_slo_state)
             checkpoint.save(ckpt_path, state, config=cfg)
     if save_at is None:
         save_at = args.rounds  # pathological; resume degenerates to a no-op
@@ -137,8 +227,24 @@ def _run_check(args):
     fs2, template = build()
     restored = checkpoint.restore(ckpt_path, template, config=cfg)
     state2 = restored
+    monitor2 = None
+    if monitor is not None and saved_slo_state is not None:
+        from deepreduce_tpu.slo import HealthMonitor
+
+        monitor2 = HealthMonitor(spec)
+        monitor2.load_state_dict(json.loads(saved_slo_state))
     for r in range(save_at, args.rounds):
-        state2, _ = fs2.step(state2, jax.random.fold_in(key, r))
+        state2, m2 = fs2.step(state2, jax.random.fold_in(key, r))
+        if monitor2 is not None:
+            rec2 = {}
+            for k, v in m2.items():
+                arr = np.asarray(v)
+                rec2[k] = (
+                    [float(x) for x in arr.reshape(-1)]
+                    if arr.ndim
+                    else float(arr)
+                )
+            monitor2.observe(r, _slo_report(rec2, _w_rel(state2.params)))
     resumed_equal = all(
         bool(jnp.all(a == b))
         for a, b in zip(
@@ -160,8 +266,7 @@ def _run_check(args):
     summary = fs.summary(state)
     run.finish(summary)
 
-    w_true = jax.random.normal(jax.random.PRNGKey(42), (dim,))
-    w_err = float(jnp.linalg.norm(state.params["w"] - w_true) / jnp.linalg.norm(w_true))
+    w_err = _w_rel(state.params)
     C = fed.clients_per_round
     checks = {
         "params_finite": all(
@@ -176,10 +281,23 @@ def _run_check(args):
         "resume_bitwise": resumed_equal,
     }
     if args.use_async:
+        hist_rows = [
+            rec["staleness_hist"]
+            for rec in rounds_hist
+            if isinstance(rec.get("staleness_hist"), list)
+        ]
         checks.update(
             {
                 "staleness_observed": any(
                     rec.get("staleness_mean", 0.0) > 0 for rec in rounds_hist
+                ),
+                # the on-device histogram is EXACT: its mass each tick is
+                # the tick's accepted-contribution count, bit for bit
+                "staleness_hist_exact": bool(hist_rows)
+                and all(
+                    abs(sum(rec["staleness_hist"]) - rec["clients"]) < 1e-3
+                    for rec in rounds_hist
+                    if isinstance(rec.get("staleness_hist"), list)
                 ),
                 "buffer_applied": sum(
                     rec.get("applied", 0.0) for rec in rounds_hist
@@ -189,6 +307,36 @@ def _run_check(args):
                     saved_buffer_fill and saved_buffer_fill > 0
                     and saved_stale_sum and saved_stale_sum > 0
                 ),
+            }
+        )
+    if args.slo:
+        from deepreduce_tpu.slo import HealthLog, validate_health_stream
+
+        logged = HealthLog.read(f"{run.dir}/health.jsonl")
+        try:
+            validate_health_stream(logged)
+            stream_valid = True
+        except ValueError:
+            stream_valid = False
+        as_lines = lambda recs: [json.dumps(x, sort_keys=True) for x in recs]
+        tail = as_lines(monitor.events[slo_events_at_save:])
+        tail2 = (
+            as_lines(monitor2.events[slo_events_at_save:])
+            if monitor2 is not None
+            else tail
+        )
+        checks.update(
+            {
+                # the churn+chaos smoke must END healthy: every target in
+                # the embedded spec holds at the final tick
+                "slo_end_healthy": monitor.healthy(),
+                # health.jsonl passes the stream validator and matches the
+                # in-memory trail record for record
+                "slo_stream_valid": stream_valid
+                and as_lines(logged) == as_lines(monitor.events),
+                # the resumed monitor replays the post-checkpoint event
+                # tail bitwise from the re-executed tick reports
+                "slo_resume_bitwise": tail == tail2,
             }
         )
     report = {
@@ -208,8 +356,25 @@ def _run_check(args):
         },
     }
     if args.use_async:
+        from deepreduce_tpu.telemetry.device_metrics import hist_quantile
+
         st_means = [rec.get("staleness_mean", 0.0) for rec in rounds_hist]
+        hist_rows = [
+            rec["staleness_hist"]
+            for rec in rounds_hist
+            if isinstance(rec.get("staleness_hist"), list)
+        ]
+        hist_total = []
+        if hist_rows:
+            depth = max(len(h) for h in hist_rows)
+            hist_total = [
+                sum(h[d] for h in hist_rows if d < len(h)) for d in range(depth)
+            ]
         report["async"] = {
+            "staleness_hist_total": hist_total,
+            "staleness_p50": hist_quantile(hist_total, 0.50),
+            "staleness_p95": hist_quantile(hist_total, 0.95),
+            "staleness_p99": hist_quantile(hist_total, 0.99),
             "fed_async_k": cfg.fed_async_k,
             "fed_async_alpha": cfg.fed_async_alpha,
             "fed_async_latency": cfg.fed_async_latency,
@@ -220,6 +385,14 @@ def _run_check(args):
             "applies": sum(rec.get("applied", 0.0) for rec in rounds_hist),
             "checkpoint_buffer_fill": saved_buffer_fill,
             "checkpoint_stale_sum": saved_stale_sum,
+        }
+    if args.slo:
+        report["slo"] = {
+            "state": monitor.state_of(0),
+            "events": len(monitor.events),
+            "health_jsonl": f"{run.dir}/health.jsonl",
+            "verdict": monitor.verdict(0),
+            "spec": spec.to_dict(),
         }
     return report
 
@@ -239,7 +412,15 @@ def _mt_rec(m):
     MAX = {"staleness_max", "version"}
     rec = {}
     for k, v in m.items():
-        vals = [float(x) for x in np.asarray(v).reshape(-1)]
+        arr = np.asarray(v)
+        if arr.ndim == 2:
+            # per-tenant VECTOR metrics ([T, D] staleness histograms):
+            # per-tenant rows under `*_t`, elementwise fleet sum under the
+            # original key (histogram counts aggregate by addition)
+            rec[k + "_t"] = [[float(x) for x in row] for row in arr]
+            rec[k] = [float(s) for s in arr.sum(axis=0)]
+            continue
+        vals = [float(x) for x in arr.reshape(-1)]
         rec[k + "_t"] = vals
         if k in SUM:
             rec[k] = float(sum(vals))
@@ -318,6 +499,11 @@ def _run_mt_check(args):
         tags=["fedsim", "mt", "check"],
     )
 
+    w_true = jax.random.normal(jax.random.PRNGKey(42), (dim,))
+    monitor = spec = None
+    if args.slo:
+        monitor, spec = _slo_monitor(args, cfg, run.dir)
+
     # tenant T-1 leaves for two ticks near the end, then rejoins — the
     # resume replay repeats this schedule by round index
     leave = set(range(args.rounds - 3, args.rounds - 1)) if T > 1 else set()
@@ -358,8 +544,32 @@ def _run_mt_check(args):
             # own outputs (the 1st pays the init->steady recompile)
             steady_cache = fs._round._cache_size()
         rec = _mt_rec(m)
+        # per-tenant convergence distance: feeds the SLO monitor's
+        # convergence-band residency target (here and offline via
+        # `telemetry slo` on the logged rows)
+        rec["w_rel_err_t"] = [
+            float(
+                jnp.linalg.norm(state.params["w"][t] - w_true)
+                / jnp.linalg.norm(w_true)
+            )
+            for t in range(T)
+        ]
         rounds_hist.append(rec)
         run.log({"round": r, **rec})
+        if monitor is not None:
+            # one report per tenant slot: the per-tenant overrides in the
+            # spec gate each tenant's own staleness tail / error budget
+            for t in range(T):
+                rep = {
+                    "clients": rec["clients_t"][t],
+                    "checksum_failures": rec["checksum_failures_t"][t],
+                    "buffer_fill": rec["buffer_fill_t"][t],
+                    "w_rel_err": rec["w_rel_err_t"][t],
+                }
+                hist_t = rec.get("staleness_hist_t")
+                if hist_t:
+                    rep["staleness_hist"] = hist_t[t]
+                monitor.observe(r, rep, tenant=t)
         if save_at is None and r + 1 >= mid:
             fills = np.asarray(state.buffer.count)
             stales = np.asarray(state.buffer.stale_sum)
@@ -410,7 +620,6 @@ def _run_mt_check(args):
     summary = fs.summary(state)
     run.finish(summary)
 
-    w_true = jax.random.normal(jax.random.PRNGKey(42), (dim,))
     w_errs = [
         float(jnp.linalg.norm(state.params["w"][t] - w_true) / jnp.linalg.norm(w_true))
         for t in range(T)
@@ -437,6 +646,21 @@ def _run_mt_check(args):
         "frozen_slot_bitwise": frozen_ok and frozen_snap is not None,
         "t_mismatch_fails_fast": t_mismatch_fast,
     }
+    if args.slo:
+        from deepreduce_tpu.slo import HealthLog, validate_health_stream
+
+        logged = HealthLog.read(f"{run.dir}/health.jsonl")
+        try:
+            validate_health_stream(logged)
+            stream_valid = True
+        except ValueError:
+            stream_valid = False
+        checks.update(
+            {
+                "slo_end_healthy": monitor.healthy(),
+                "slo_stream_valid": stream_valid,
+            }
+        )
     report = {
         "ok": all(checks.values()),
         "checks": checks,
@@ -456,6 +680,14 @@ def _run_mt_check(args):
             "fed_mt_cohort": overrides["fed_mt_cohort"],
         },
     }
+    if args.slo:
+        report["slo"] = {
+            "states": {str(t): s for t, s in monitor.final_states().items()},
+            "events": len(monitor.events),
+            "health_jsonl": f"{run.dir}/health.jsonl",
+            "verdicts": {str(t): monitor.verdict(t) for t in range(T)},
+            "spec": spec.to_dict(),
+        }
     return report
 
 
@@ -485,6 +717,16 @@ def main(argv=None) -> int:
         help="asynchronous buffered mode: staleness-weighted ingest ticks, "
              "K-threshold buffered applies, mid-buffer bitwise resume "
              "(make fedasync-check)")
+    p_check.add_argument(
+        "--slo", action="store_true",
+        help="run the SLO health monitor over the tick stream: writes "
+             "RUN/health.jsonl, checkpoints the monitor state for the "
+             "bitwise tail replay, and the check must END healthy "
+             "(make slo-check)")
+    p_check.add_argument(
+        "--slo_spec", type=str, default="",
+        help="SLOSpec JSON path for --slo; default: the embedded "
+             "churn+chaos smoke spec")
     p_check.add_argument(
         "--tenants", type=int, default=0,
         help="multi-tenant smoke: T heterogeneous async populations "

@@ -398,7 +398,14 @@ class FedSim:
                 bank = jax.jit(_zeros, out_shardings=shardings)()
             else:
                 bank = _zeros()
-        acc = MetricAccumulators.zeros() if self.cfg_c2s.telemetry else None
+        acc = None
+        if self.cfg_c2s.telemetry:
+            # async mode grows the accumulator's staleness-histogram vector
+            # to the latency depth D (f32[0] otherwise — sync fetch/derive
+            # output is unchanged)
+            acc = MetricAccumulators.zeros(
+                num_stale_levels=len(self.latency_probs) if self.fed_async else 0
+            )
         if self.checksum or self.chaos is not None:
             self.build_layout(params)
         w_ref = jax.tree_util.tree_map(jnp.array, params)
@@ -480,7 +487,11 @@ class FedSim:
         if self.cfg_c2s.telemetry:
             acc = jax.tree_util.tree_map(
                 lambda a: jnp.zeros((T,) + a.shape, a.dtype),
-                MetricAccumulators.zeros(),
+                MetricAccumulators.zeros(
+                    num_stale_levels=(
+                        len(self.mt_latency[0]) if self.fed_async else 0
+                    )
+                ),
             )
         if self.checksum or self.chaos is not None:
             self.build_layout(params)
@@ -810,13 +821,25 @@ class FedSim:
         # weighted live mass of this worker's stratum: the apply denominator
         taus_local = jax.lax.dynamic_slice(taus, (widx * C_local,), (C_local,))
         wsum = jnp.sum(live * staleness_weights(taus_local.astype(jnp.float32), alpha))
+        # exact per-level staleness histogram of ACCEPTED contributions in
+        # this worker's stratum: `live` is churn- and checksum-gated, so
+        # the histogram prices what the buffer actually ingested — the tail
+        # statistics (p50/p95/p99) the SLO health plane gates on. f32[D],
+        # one extra member of the fused psum below (zero extra collectives)
+        levels = jnp.arange(D, dtype=taus_local.dtype)
+        st_hist = jnp.sum(
+            live[:, None]
+            * (taus_local[:, None] == levels[None, :]).astype(jnp.float32),
+            axis=0,
+        )
 
         # --- the tick's ONE cross-worker collective (the fedsim:async-round
         # audit spec pins it): partial weighted update sums, wire bits,
-        # live/failure counts and the weighted live mass, one psum tuple
+        # live/failure counts, the weighted live mass and the staleness
+        # histogram, one psum tuple
         if self.W > 1:
-            upd_sum, wire4, nlive, nfail, wsum = jax.lax.psum(
-                (upd_sum, wire4, nlive, nfail, wsum), self.axis
+            upd_sum, wire4, nlive, nfail, wsum, st_hist = jax.lax.psum(
+                (upd_sum, wire4, nlive, nfail, wsum, st_hist), self.axis
             )
 
         # --- staleness bookkeeping over TRANSMITTING clients (a
@@ -891,6 +914,7 @@ class FedSim:
             "rel_volume": wire.rel_volume(),
             "staleness_mean": st_mean,
             "staleness_max": st_max,
+            "staleness_hist": st_hist,
             "buffer_fill": new_count,
             "buffer_weight": new_weight,
             "applied": applied,
@@ -902,6 +926,7 @@ class FedSim:
                 live_workers=nlive,
                 dropped_steps=jnp.asarray(nlive < C, jnp.float32),
                 checksum_failures=nfail,
+                staleness_hist=st_hist,
             )
         return new_params, w_ref, bank, acc, rnd + 1, metrics, new_buf
 

@@ -1,0 +1,32 @@
+"""SLO health plane for the federated serving path.
+
+`spec.py` defines the schema-validated `SLOSpec` (targets + windows +
+burn-rate thresholds, with per-tenant overrides); `monitor.py` runs a
+`HealthMonitor` over the telemetry report stream — a host-side pure
+function of the recorded metrics, so its ``health.jsonl`` event trail
+replays bitwise across checkpoint kill/resume (`fedsim check --slo`
+enforces this). The on-device side is the exact staleness histogram
+that rides the ONE fused psum of the async tick (fedsim/sim.py); the
+monitor only ever consumes what telemetry already logged.
+"""
+
+from deepreduce_tpu.slo.spec import SLOSpec, TARGET_KEYS
+from deepreduce_tpu.slo.monitor import (
+    HEALTH_SCHEMA,
+    HEALTH_STATES,
+    HealthLog,
+    HealthMonitor,
+    validate_health,
+    validate_health_stream,
+)
+
+__all__ = [
+    "SLOSpec",
+    "TARGET_KEYS",
+    "HEALTH_SCHEMA",
+    "HEALTH_STATES",
+    "HealthLog",
+    "HealthMonitor",
+    "validate_health",
+    "validate_health_stream",
+]

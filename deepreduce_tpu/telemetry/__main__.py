@@ -54,6 +54,19 @@ offline consumer of tracking.py run directories.
                              ``--profile`` twice to compare the picks of
                              two fitted profiles (A vs B) instead of
                              static vs fitted.
+- ``slo RUN --spec slo.json``
+                             per-tenant SLO verdict table: replay the
+                             run's tick stream through the health monitor
+                             (slo/monitor.py) and print each target's
+                             windowed value vs threshold plus any
+                             OK/DEGRADED/BREACH transitions; exits 1 when
+                             any tenant ends in BREACH — the gate a
+                             "healthy at N clients/s" claim hangs on.
+- ``bench-history [DIR]``    longitudinal view of the committed
+                             BENCH_*.json ledger: one provenance-stamped
+                             trend row per round (headline metric,
+                             modeled/measured flag, profile_sha256 when
+                             present); exits 2 on a schema-less record.
 - ``profiles A.json B.json [...] --against BENCH.json``
                              cross-profile drift sentinel: per-parameter
                              drift between saved machine profiles,
@@ -166,11 +179,20 @@ def _step_times(
 
 
 def _percentile(xs: List[float], q: float) -> float:
+    """Sorted linear-interpolation quantile (numpy's default 'linear'
+    method, without numpy): exact order statistics at the grid points,
+    interpolated between them — so p95/p99 of short series move smoothly
+    instead of snapping to the nearest rank."""
     if not xs:
         return float("nan")
     ys = sorted(xs)
-    i = min(len(ys) - 1, max(0, round(q * (len(ys) - 1))))
-    return ys[int(i)]
+    if len(ys) == 1:
+        return ys[0]
+    pos = q * (len(ys) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(ys) - 1)
+    frac = pos - lo
+    return ys[lo] * (1.0 - frac) + ys[hi] * frac
 
 
 def _dist(xs: List[float]) -> Dict[str, float]:
@@ -181,6 +203,8 @@ def _dist(xs: List[float]) -> Dict[str, float]:
         "mean": sum(xs) / len(xs),
         "p50": _percentile(xs, 0.5),
         "p90": _percentile(xs, 0.9),
+        "p95": _percentile(xs, 0.95),
+        "p99": _percentile(xs, 0.99),
         "min": min(xs),
         "max": max(xs),
     }
@@ -191,7 +215,8 @@ def _fmt_dist(d: Dict[str, float], unit: str = "") -> str:
         return "(no samples)"
     return (
         f"mean {d['mean']:.6g}{unit}  p50 {d['p50']:.6g}{unit}  "
-        f"p90 {d['p90']:.6g}{unit}  n={d['n']}"
+        f"p90 {d['p90']:.6g}{unit}  p95 {d['p95']:.6g}{unit}  "
+        f"p99 {d['p99']:.6g}{unit}  n={d['n']}"
     )
 
 
@@ -295,6 +320,25 @@ def _fedsim_report(hist: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         out["fed_staleness_mean"] = sum(st_mean) / len(st_mean)
     if st_max:
         out["fed_staleness_max"] = max(st_max)
+    # exact staleness tail from the on-device per-tick histograms (the new
+    # psum members): sum the f32[D] rows over the run, then read discrete
+    # quantiles off the cumulative counts — no sampling, no interpolation
+    hists = [
+        r["staleness_hist"] for r in hist
+        if isinstance(r.get("staleness_hist"), list) and r["staleness_hist"]
+    ]
+    if hists:
+        from deepreduce_tpu.telemetry.device_metrics import hist_quantile
+
+        depth = max(len(h) for h in hists)
+        total = [
+            sum(float(h[d]) for h in hists if d < len(h))
+            for d in range(depth)
+        ]
+        out["fed_staleness_hist_total"] = total
+        out["fed_staleness_p50"] = hist_quantile(total, 0.50)
+        out["fed_staleness_p95"] = hist_quantile(total, 0.95)
+        out["fed_staleness_p99"] = hist_quantile(total, 0.99)
     fills = [
         float(r["buffer_fill"])
         for r in hist
@@ -351,16 +395,46 @@ def _mt_fedsim_rows(hist: List[Dict[str, Any]]) -> Dict[str, Any]:
         out["fed_mt_clients_per_sec"] = [
             (sum(r) / len(r)) if r else 0.0 for r in rates
         ]
+    # rows can be RAGGED: a run dir mixing single-tenant and MT records,
+    # or a tenant-geometry change mid-dir, logs rows shorter than T —
+    # average/maximize each slot over the rows that actually carry it
+    # instead of indexing row[t] into an IndexError
     st_mean_t = _mt_series(hist, "staleness_mean_t")
     if st_mean_t:
         out["fed_mt_staleness_mean"] = [
-            sum(row[t] for row in st_mean_t) / len(st_mean_t) for t in range(T)
+            (
+                sum(row[t] for row in st_mean_t if t < len(row))
+                / max(sum(1 for row in st_mean_t if t < len(row)), 1)
+            )
+            for t in range(T)
         ]
     st_max_t = _mt_series(hist, "staleness_max_t")
     if st_max_t:
         out["fed_mt_staleness_max"] = [
-            max(row[t] for row in st_max_t) for t in range(T)
+            max((row[t] for row in st_max_t if t < len(row)), default=0.0)
+            for t in range(T)
         ]
+    # per-tenant staleness tails from the [T, D] on-device histogram rows
+    hist_t_rows = [
+        r["staleness_hist_t"] for r in hist
+        if isinstance(r.get("staleness_hist_t"), list) and r["staleness_hist_t"]
+    ]
+    if hist_t_rows:
+        from deepreduce_tpu.telemetry.device_metrics import hist_quantile
+
+        totals: List[List[float]] = [[] for _ in range(T)]
+        for row in hist_t_rows:
+            for t, h in enumerate(row):
+                if t >= T or not isinstance(h, list):
+                    continue
+                if len(h) > len(totals[t]):
+                    totals[t].extend([0.0] * (len(h) - len(totals[t])))
+                for d, v in enumerate(h):
+                    totals[t][d] += float(v)
+        for q, name in ((0.50, "fed_mt_staleness_p50"),
+                        (0.95, "fed_mt_staleness_p95"),
+                        (0.99, "fed_mt_staleness_p99")):
+            out[name] = [hist_quantile(tot, q) for tot in totals]
     # per-tenant buffer occupancy at that tenant's own applies (the
     # tenant-indexed fed_buffer_fill_per_apply)
     fill_rows = [
@@ -425,6 +499,14 @@ def cmd_summary(args) -> int:
             print(f"    fed_staleness_mean: {fed['fed_staleness_mean']:.6g}")
         if "fed_staleness_max" in fed:
             print(f"    fed_staleness_max: {fed['fed_staleness_max']:.6g}")
+        if "fed_staleness_p95" in fed:
+            print(
+                "    fed_staleness_tail: "
+                f"p50 {fed['fed_staleness_p50']:.6g}  "
+                f"p95 {fed['fed_staleness_p95']:.6g}  "
+                f"p99 {fed['fed_staleness_p99']:.6g}  "
+                "(exact, on-device histogram)"
+            )
         if "fed_buffer_fill_per_apply" in fed:
             print(
                 "    fed_buffer_fill_per_apply: "
@@ -436,6 +518,9 @@ def cmd_summary(args) -> int:
                 "fed_mt_clients_per_sec",
                 "fed_mt_staleness_mean",
                 "fed_mt_staleness_max",
+                "fed_mt_staleness_p50",
+                "fed_mt_staleness_p95",
+                "fed_mt_staleness_p99",
                 "fed_mt_buffer_fill_per_apply",
             ):
                 if row in fed:
@@ -917,6 +1002,262 @@ def cmd_profiles(args) -> int:
 
 
 # ---------------------------------------------------------------------- #
+# slo (health verdict, exit-gated)
+# ---------------------------------------------------------------------- #
+
+
+def cmd_slo(args) -> int:
+    """`slo RUN --spec slo.json`: replay the run's metrics.jsonl tick
+    stream through a fresh HealthMonitor and print the per-tenant verdict
+    table. Exit 1 when any tenant ends in BREACH — the gate a CI job or a
+    bench claim can hang a "healthy at N clients/s" statement on. The
+    monitor consumes only recorded rows, so re-running the command on the
+    same run dir is bitwise-repeatable."""
+    run = _resolve_run(args.run)
+    if run is None:
+        return _fail(f"no run directory under {args.run!r}")
+    from deepreduce_tpu.slo import HealthMonitor, SLOSpec
+
+    try:
+        spec = SLOSpec.load(args.spec)
+    except ValueError as e:
+        return _fail(str(e))
+    if spec.is_noop:
+        print(f"slo: run {run.name}: spec {args.spec} sets no targets — "
+              "nothing to monitor (degenerate spec, monitor is a no-op)")
+        return 0
+    hist = _history(run)
+    rows = [
+        r for r in hist
+        if isinstance(r.get("clients"), (int, float))
+        or isinstance(r.get("clients_t"), list)
+    ]
+    if not rows:
+        return _fail(
+            f"run {run.name} has no federated tick rows (clients / "
+            "clients_t) for the SLO monitor"
+        )
+    monitor = HealthMonitor(spec)
+    tenants = 1
+    rates: Dict[int, List[float]] = {}
+    prev_ts: Optional[float] = None
+    try:
+        for i, r in enumerate(rows):
+            tick = (
+                int(r["round"])
+                if isinstance(r.get("round"), (int, float))
+                else i
+            )
+            ts = r.get("ts")
+            dt = None
+            if (isinstance(ts, (int, float))
+                    and isinstance(prev_ts, (int, float)) and ts > prev_ts):
+                dt = ts - prev_ts
+            if isinstance(ts, (int, float)):
+                prev_ts = ts
+            if isinstance(r.get("clients_t"), list):
+                T = len(r["clients_t"])
+                tenants = max(tenants, T)
+                for t in range(T):
+
+                    def pick(key):
+                        v = r.get(key)
+                        if isinstance(v, list) and t < len(v):
+                            return v[t]
+                        return None
+
+                    rep = {
+                        "clients": pick("clients_t"),
+                        "checksum_failures": pick("checksum_failures_t"),
+                        "buffer_fill": pick("buffer_fill_t"),
+                        "w_rel_err": pick("w_rel_err_t"),
+                    }
+                    hl = r.get("staleness_hist_t")
+                    if (isinstance(hl, list) and t < len(hl)
+                            and isinstance(hl[t], list)):
+                        rep["staleness_hist"] = hl[t]
+                    if dt and rep["clients"] is not None:
+                        rep["clients_per_sec"] = float(rep["clients"]) / dt
+                        rates.setdefault(t, []).append(
+                            rep["clients_per_sec"]
+                        )
+                    monitor.observe(tick, rep, tenant=t)
+            else:
+                rep = {
+                    "clients": r.get("clients"),
+                    "checksum_failures": r.get("checksum_failures"),
+                    "buffer_fill": r.get("buffer_fill"),
+                    "w_rel_err": r.get("w_rel_err"),
+                }
+                if isinstance(r.get("staleness_hist"), list):
+                    rep["staleness_hist"] = r["staleness_hist"]
+                if dt and isinstance(r.get("clients"), (int, float)):
+                    rep["clients_per_sec"] = float(r["clients"]) / dt
+                    rates.setdefault(0, []).append(rep["clients_per_sec"])
+                monitor.observe(tick, rep)
+    except ValueError as e:
+        return _fail(f"run {run.name}: {e}")
+
+    verdicts = [monitor.verdict(t) for t in range(tenants)]
+    states = [v["state"] for v in verdicts]
+    if args.json:
+        print(json.dumps(
+            {
+                "run": run.name,
+                "spec": spec.to_dict(),
+                "ticks": len(rows),
+                "events": monitor.events,
+                "verdicts": verdicts,
+            },
+            indent=2,
+        ))
+        return 1 if "BREACH" in states else 0
+    print(f"slo: run {run.name}  spec {args.spec}  "
+          f"({len(rows)} tick(s), {tenants} tenant(s))")
+    if monitor.events:
+        print(f"  {len(monitor.events)} health transition(s):")
+        for ev in monitor.events:
+            detail = ""
+            if ev["value"] is not None:
+                detail = f"  {ev['value']:.6g} vs {ev['threshold']:.6g}"
+            print(
+                f"    tick {ev['tick']} tenant {ev['tenant']}: "
+                f"{ev['from_state']} -> {ev['to_state']} "
+                f"({ev['trigger']}){detail}"
+            )
+    else:
+        print("  0 health transitions")
+    for v in verdicts:
+        t = v["tenant"]
+        print(f"  tenant {t}: {v['state']}")
+        if t in rates:
+            print(f"    clients_per_sec: {_fmt_dist(_dist(rates[t]))}")
+        for key, row in v["targets"].items():
+            if row["value"] is None:
+                shown = "(no data)"
+            else:
+                shown = f"{row['value']:.6g} vs {row['threshold']:.6g}"
+            burn = ""
+            if row["burn_fast"] is not None:
+                burn = (f"  burn fast {row['burn_fast']:.3g}x / "
+                        f"slow {row['burn_slow']:.3g}x")
+            flag = "ok" if row["ok"] else "VIOLATED"
+            print(f"    {key}: {shown}{burn}  {flag}")
+    if "BREACH" in states:
+        print("slo: BREACH — at least one tenant ends outside its SLO",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+# ---------------------------------------------------------------------- #
+# bench-history (longitudinal ledger view)
+# ---------------------------------------------------------------------- #
+
+
+def cmd_bench_history(args) -> int:
+    """`bench-history [DIR]`: one provenance-stamped trend row per
+    committed BENCH_*.json record, ordered by the round number parsed
+    from the filename. Modern records carry metric/value/unit/platform
+    (+ optional provenance lists and profile_sha256); the r01–r05 raw-log
+    records and the TPU midround record render as `legacy` rows from
+    their parsed/headline payloads. A record matching NONE of those
+    shapes exits 2 — the ledger is an interface, not a junk drawer."""
+    import re
+
+    root = pathlib.Path(args.dir)
+    paths = sorted(root.glob("BENCH_*.json"))
+    if not paths:
+        return _fail(f"no BENCH_*.json records under {root}")
+    rows: List[Dict[str, Any]] = []
+    for path in paths:
+        try:
+            rec = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            return _fail(f"{path.name}: unreadable bench record: {e}")
+        m = re.search(r"_r(\d+)", path.stem)
+        row: Dict[str, Any] = {
+            "round": int(m.group(1)) if m else -1,
+            "file": path.name,
+        }
+        detail = rec.get("detail")
+        sha = rec.get("profile_sha256")
+        if sha is None and isinstance(detail, dict):
+            sha = detail.get("profile_sha256")
+        if isinstance(rec.get("metric"), str):
+            prov = rec.get("provenance")
+            if isinstance(prov, dict):
+                has_mod = bool(prov.get("modeled"))
+                has_meas = bool(prov.get("measured"))
+                stamp = (
+                    "modeled+measured" if has_mod and has_meas
+                    else "modeled" if has_mod
+                    else "measured" if has_meas
+                    else "unstamped"
+                )
+            else:
+                stamp = "unstamped"
+            row.update(
+                metric=rec["metric"],
+                value=rec.get("value"),
+                unit=rec.get("unit", ""),
+                platform=rec.get("platform", "?"),
+                provenance=stamp,
+            )
+        elif {"cmd", "rc", "n"} <= set(rec):
+            parsed = rec.get("parsed")
+            parsed = parsed if isinstance(parsed, dict) else {}
+            row.update(
+                metric=parsed.get("metric", "(raw log)"),
+                value=parsed.get("value"),
+                unit=parsed.get("unit", ""),
+                platform=rec.get("platform", "?"),
+                provenance="legacy",
+            )
+        elif (isinstance(rec.get("headline"), dict)
+              and isinstance(rec["headline"].get("metric"), str)):
+            h = rec["headline"]
+            row.update(
+                metric=h["metric"],
+                value=h.get("value"),
+                unit=h.get("unit", ""),
+                platform=rec.get("platform", "?"),
+                provenance="legacy",
+            )
+        else:
+            return _fail(
+                f"{path.name}: schema-less bench record — carries neither "
+                "a 'metric' headline, a raw-log (cmd/rc/n) shape, nor a "
+                "'headline' block"
+            )
+        if sha:
+            row["profile_sha256"] = sha
+        rows.append(row)
+    rows.sort(key=lambda r: (r["round"], r["file"]))
+    if args.json:
+        print(json.dumps(rows, indent=2))
+        return 0
+    print(f"bench-history: {len(rows)} record(s) under {root}")
+    for row in rows:
+        val = (
+            f" = {row['value']:.6g}{row['unit']}"
+            if isinstance(row["value"], (int, float))
+            else ""
+        )
+        sha = (
+            f"  profile:{str(row['profile_sha256'])[:12]}"
+            if "profile_sha256" in row
+            else ""
+        )
+        print(
+            f"  r{row['round']:02d}  {row['file']:<28} "
+            f"{row['metric']}{val}  [{row['platform']}]  "
+            f"{row['provenance']}{sha}"
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------- #
 # trace
 # ---------------------------------------------------------------------- #
 
@@ -1029,6 +1370,63 @@ def cmd_trace(args) -> int:
                     "pid": 1,
                     "tid": 0,
                     "args": {key: float(val)},
+                }
+            )
+    # per-tick staleness percentiles become counter tracks of their own:
+    # the logged staleness_hist rows are lists (skipped by the scalar
+    # counter loop above), so read the exact discrete quantiles off each
+    # tick's histogram — the Perfetto view of the SLO plane's tail signal
+    st_rows = [
+        r for r in hist
+        if "ts" in r and isinstance(r.get("staleness_hist"), list)
+        and r["staleness_hist"]
+    ]
+    if st_rows and ts0 is not None:
+        from deepreduce_tpu.telemetry.device_metrics import hist_quantile
+
+        for rec in st_rows:
+            ts = round((rec["ts"] - ts0) * 1e6, 3)
+            for q, name in ((0.50, "fed_staleness_p50"),
+                            (0.95, "fed_staleness_p95"),
+                            (0.99, "fed_staleness_p99")):
+                events.append(
+                    {"name": name, "ph": "C", "ts": ts, "pid": 1, "tid": 0,
+                     "args": {name: float(
+                         hist_quantile(rec["staleness_hist"], q)
+                     )}}
+                )
+    # SLO health transitions (health.jsonl) become global instant markers,
+    # anchored like ctrl decisions: the records carry no wall clock by
+    # design, so map tick -> ts via the metrics.jsonl round column
+    hpath = run / "health.jsonl"
+    if hpath.exists() and ts0 is not None:
+        round_ts = {
+            int(r["round"]): r["ts"]
+            for r in hist
+            if isinstance(r.get("round"), (int, float)) and "ts" in r
+        }
+        max_known = max(round_ts) if round_ts else 0
+        with open(hpath) as f:
+            hrecs = [json.loads(ln) for ln in f if ln.strip()]
+        for rec in hrecs:
+            tick = int(rec.get("tick", 0))
+            anchor = tick if tick in round_ts else min(tick, max_known)
+            while anchor > 0 and anchor not in round_ts:
+                anchor -= 1
+            ts = round((round_ts.get(anchor, ts0) - ts0) * 1e6, 3)
+            events.append(
+                {
+                    "name": (
+                        f"slo {rec.get('from_state')}->"
+                        f"{rec.get('to_state')} tenant "
+                        f"{rec.get('tenant')} ({rec.get('trigger')})"
+                    ),
+                    "ph": "i", "s": "g", "ts": ts, "pid": 1, "tid": 0,
+                    "args": {
+                        "trigger": rec.get("trigger"),
+                        "value": rec.get("value"),
+                        "threshold": rec.get("threshold"),
+                    },
                 }
             )
     # adaptive-controller decisions ride along as their own counter tracks
@@ -1186,6 +1584,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--json", action="store_true",
                    help="also print the machine-readable drift report")
     p.set_defaults(fn=cmd_profiles)
+
+    p = sub.add_parser(
+        "slo",
+        help="per-tenant SLO verdict table from a run's tick stream; "
+             "exits 1 when any tenant ends in BREACH",
+    )
+    p.add_argument("run", help="run dir or tracking root (latest run)")
+    p.add_argument("--spec", required=True, metavar="SLO.json",
+                   help="schema-validated SLOSpec file (slo/spec.py)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable verdicts/events instead of the "
+                        "table")
+    p.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser(
+        "bench-history",
+        help="longitudinal view of the committed BENCH_*.json ledger: one "
+             "provenance-stamped trend row per round (exit 2 on a "
+             "schema-less record)",
+    )
+    p.add_argument("dir", nargs="?", default=".",
+                   help="directory holding BENCH_*.json (default: .)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable rows")
+    p.set_defaults(fn=cmd_bench_history)
 
     p = sub.add_parser("trace", help="merged Chrome trace JSON (Perfetto)")
     p.add_argument("run")

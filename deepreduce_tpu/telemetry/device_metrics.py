@@ -46,7 +46,36 @@ def fetch_delta(cur: Dict[str, Any], prev: Dict[str, Any]) -> Dict[str, Any]:
             f"fetch_delta bucket vector length mismatch: {len(cur_b)} vs {len(prev_b)}"
         )
     out["bucket_saturated"] = [c - p for c, p in zip(cur_b, prev_b)]
+    cur_s = cur.get("staleness_hist", [])
+    prev_s = prev.get("staleness_hist", [])
+    if cur_s or prev_s:
+        if len(cur_s) != len(prev_s):
+            raise ValueError(
+                "fetch_delta staleness_hist length mismatch: "
+                f"{len(cur_s)} vs {len(prev_s)}"
+            )
+        out["staleness_hist"] = [c - p for c, p in zip(cur_s, prev_s)]
     return out
+
+
+def hist_quantile(hist, q: float) -> float:
+    """Exact q-quantile of the discrete staleness distribution a counts
+    histogram encodes: the smallest level d whose CDF reaches q. Staleness
+    levels are integers (indices into the latency distribution), so no
+    interpolation is involved — the returned tail is exact, not estimated.
+    0.0 on an empty/all-zero histogram (a degenerate run observed nothing)."""
+    counts = [max(float(h), 0.0) for h in hist]
+    total = sum(counts)
+    if total <= 0.0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for d, h in enumerate(counts):
+        cum += h
+        # 1e-9 absorbs f32-accumulated rounding at exact-boundary targets
+        if cum + 1e-9 >= target:
+            return float(d)
+    return float(len(counts) - 1)
 
 
 @jax.tree_util.register_dataclass
@@ -89,17 +118,26 @@ class MetricAccumulators:
     # bucketed exchange (f32[0] when unbucketed) — keeps one chronically
     # overfull bucket visible next to the summed `saturated` total
     bucket_saturated: jax.Array
+    # Σ per-staleness-level ACCEPTED-contribution counts, f32[D] in latency
+    # level order for the asynchronous federated tick (f32[0] everywhere
+    # else) — the exact cumulative staleness distribution the SLO health
+    # plane derives its p50/p95/p99 tails from
+    staleness_hist: jax.Array
 
     @classmethod
-    def zeros(cls, num_buckets: int = 0) -> "MetricAccumulators":
+    def zeros(cls, num_buckets: int = 0, num_stale_levels: int = 0) -> "MetricAccumulators":
         # one FRESH buffer per field: the accumulator is donated to the jitted
         # step (train.Trainer._build), and donating one shared zeros() buffer
         # for every field is a donate-twice XLA runtime error
         scalars = tuple(
             jnp.zeros((), jnp.float32)
-            for _ in range(len(dataclasses.fields(cls)) - 1)
+            for _ in range(len(dataclasses.fields(cls)) - 2)
         )
-        return cls(*scalars, jnp.zeros((int(num_buckets),), jnp.float32))
+        return cls(
+            *scalars,
+            jnp.zeros((int(num_buckets),), jnp.float32),
+            jnp.zeros((int(num_stale_levels),), jnp.float32),
+        )
 
     def accumulate(
         self,
@@ -119,6 +157,7 @@ class MetricAccumulators:
         rs_oktopk_threshold=0.0,
         rs_oktopk_spills=0.0,
         bucket_saturated=0.0,
+        staleness_hist=0.0,
     ) -> "MetricAccumulators":
         f = lambda x: jnp.asarray(x, jnp.float32)
         return MetricAccumulators(
@@ -145,6 +184,7 @@ class MetricAccumulators:
             # caller has nothing to report this step (and [0] + 0.0 when
             # unbucketed — a no-op on the empty vector)
             bucket_saturated=self.bucket_saturated + f(bucket_saturated),
+            staleness_hist=self.staleness_hist + f(staleness_hist),
         )
 
     # ------------------------------------------------------------------ #
@@ -162,15 +202,20 @@ class MetricAccumulators:
     @classmethod
     def scalar_fields(cls) -> Tuple[str, ...]:
         """Field names of the scalar counters, in declaration order
-        (everything except the vector-valued `bucket_saturated`)."""
+        (everything except the vector-valued `bucket_saturated` and
+        `staleness_hist`)."""
         return tuple(
-            f.name for f in dataclasses.fields(cls) if f.name != "bucket_saturated"
+            f.name
+            for f in dataclasses.fields(cls)
+            if f.name not in ("bucket_saturated", "staleness_hist")
         )
 
     def fetch(self) -> Dict[str, Any]:
         """Materialise the cumulative counters to host plain floats —
         the telemetry_every sync point. Scalars by field name, plus
-        `bucket_saturated` as a list of floats."""
+        `bucket_saturated` as a list of floats (and `staleness_hist`
+        when the accumulator carries one — async fedsim only, so every
+        pre-existing consumer's key set is unchanged)."""
         vals: Dict[str, Any] = {
             name: float(np.asarray(getattr(self, name)))
             for name in self.scalar_fields()
@@ -179,6 +224,11 @@ class MetricAccumulators:
             float(v)
             for v in np.asarray(self.bucket_saturated, np.float32).reshape(-1)
         ]
+        if self.staleness_hist.size:
+            vals["staleness_hist"] = [
+                float(v)
+                for v in np.asarray(self.staleness_hist, np.float32).reshape(-1)
+            ]
         return vals
 
     @staticmethod
@@ -193,6 +243,15 @@ class MetricAccumulators:
         out: Dict[str, Any] = {}
         if len(bucket_sat):
             out["bucket_saturated_per_step"] = [float(v) / steps for v in bucket_sat]
+        stale_hist = vals.get("staleness_hist", [])
+        if len(stale_hist):
+            # exact staleness tails from the cumulative on-device histogram
+            # (async fedsim): the distribution over every ACCEPTED
+            # contribution this accumulator has seen
+            out["staleness_hist"] = [float(v) for v in stale_hist]
+            out["staleness_p50"] = hist_quantile(stale_hist, 0.50)
+            out["staleness_p95"] = hist_quantile(stale_hist, 0.95)
+            out["staleness_p99"] = hist_quantile(stale_hist, 0.99)
         return out | {
             "steps": vals["steps"],
             "cumulative_total_bits": vals["index_bits"] + vals["value_bits"],

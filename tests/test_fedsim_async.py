@@ -173,7 +173,10 @@ def test_async_buffer_cadence_and_staleness(mesh8):
     hist = []
     for r in range(6):
         st, m = fs.step(st, jax.random.fold_in(key, r))
-        hist.append({k: float(v) for k, v in m.items()})
+        hist.append({
+            k: (np.asarray(v).tolist() if np.asarray(v).ndim else float(v))
+            for k, v in m.items()
+        })
     # 16 live clients/tick, K=40: applies at ticks 2 and 5 (48 buffered)
     assert [h["applied"] for h in hist] == [0.0, 0.0, 1.0, 0.0, 0.0, 1.0]
     assert [h["buffer_fill"] for h in hist] == [16.0, 32.0, 48.0, 16.0, 32.0, 48.0]
@@ -188,6 +191,42 @@ def test_async_buffer_cadence_and_staleness(mesh8):
         bool(jnp.all(jnp.isfinite(x)))
         for x in jax.tree_util.tree_leaves(st.params)
     )
+
+
+def test_async_staleness_histogram_exact(mesh8):
+    """The on-device staleness histogram (a psum-tuple member, r23 health
+    plane): f32[D] per tick, counting exactly the ACCEPTED contributions
+    at each staleness level — sum equals the live-client count every tick,
+    the exact tail quantiles derive from it, and the histogram-implied
+    mean/max agree with the scalar staleness metrics."""
+    from deepreduce_tpu.telemetry.device_metrics import hist_quantile
+
+    cfg = _cfg(**_fed_kw(fed_async=True, fed_async_k=40, fed_async_alpha=0.5,
+                         fed_async_latency="0.5,0.3,0.2"))
+    key = jax.random.PRNGKey(1)
+    fs, st = _driver(cfg, mesh8)
+    total = None
+    for r in range(5):
+        st, m = fs.step(st, jax.random.fold_in(key, r))
+        h = np.asarray(m["staleness_hist"], dtype=np.float64)
+        assert h.shape == (3,)  # D = len(parse_latency("0.5,0.3,0.2"))
+        assert np.all(h >= 0)
+        # per-tick exactness: every accepted contribution lands in
+        # exactly one level (no churn here, so accepted == clients)
+        assert float(h.sum()) == float(m["clients"])
+        # the scalar metrics are derivable from the histogram
+        if h.sum() > 0:
+            mean_h = float((h * np.arange(3)).sum() / h.sum())
+            assert mean_h == pytest.approx(float(m["staleness_mean"]),
+                                           abs=1e-5)
+            max_h = float(np.max(np.nonzero(h)[0]))
+            assert max_h == float(m["staleness_max"])
+        total = h if total is None else total + h
+    # the deterministic 3-level latency plan populates a genuine tail:
+    # nonzero mass above level 0, and an exact p95 within the level range
+    assert float(total[1:].sum()) > 0
+    p95 = hist_quantile(total.tolist(), 0.95)
+    assert 0.0 < p95 <= 2.0
 
 
 def test_async_stream_matches_step_loop(mesh8):
@@ -287,3 +326,39 @@ def test_costmodel_fed_async():
         1.0, 10, t_client_s=4.0, overlap_depth=1, latency_probs=(0.5, 0.3, 0.2)
     )
     assert stale > slow
+
+
+# ---------------------------------------------------------------------- #
+# driver-level SLO gate (mesh-heavy: excluded from tier-1)
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_fedsim_check_async_slo_gate(tmp_path, capsys):
+    """`fedsim check --async --slo` end-to-end (what make slo-check runs):
+    the churn+chaos smoke must end healthy, the monitor's staleness-p95
+    verdict must be fed by the on-device histogram (nonzero under the
+    3-level latency plan), health.jsonl must be schema-valid, and the
+    post-checkpoint health tail must replay bitwise on resume."""
+    import json as _json
+
+    from deepreduce_tpu.fedsim.__main__ import main as fedsim_main
+    from deepreduce_tpu.slo import HealthLog, validate_health_stream
+
+    rc = fedsim_main([
+        "check", "--async", "--slo", "--rounds", "8",
+        "--track_dir", str(tmp_path),
+    ])
+    report = _json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["ok"]
+    checks = report["checks"]
+    assert checks["slo_end_healthy"]
+    assert checks["slo_stream_valid"]
+    assert checks["slo_resume_bitwise"]
+    assert checks["staleness_hist_exact"]
+    # the verdict's staleness tail comes from the on-device histogram
+    verdict = report["slo"]["verdict"]["targets"]["staleness_p95_max"]
+    assert verdict["ok"] and verdict["value"] > 0.0
+    validate_health_stream(HealthLog.read(tmp_path / "check" / "health.jsonl"))
+    # the monitor's checkpoint sidecar rides next to the run dir
+    assert (tmp_path / "slo_state.json").exists()

@@ -1,0 +1,370 @@
+"""SLO health plane: spec schema validation, health.jsonl record/stream
+contracts, the hysteretic OK/DEGRADED/BREACH ladder with pinned transition
+ticks, fast/slow error-budget burn math, bitwise state_dict replay, and the
+provable no-op of the degenerate (target-less) spec."""
+
+import json
+
+import pytest
+
+from deepreduce_tpu.config import ConfigError, reason_code_of
+from deepreduce_tpu.slo import (
+    HEALTH_SCHEMA,
+    HEALTH_STATES,
+    HealthLog,
+    HealthMonitor,
+    SLOSpec,
+    TARGET_KEYS,
+    validate_health,
+    validate_health_stream,
+)
+
+
+# ---------------------------------------------------------------------- #
+# SLOSpec parsing + rejection
+# ---------------------------------------------------------------------- #
+
+
+def test_spec_defaults_and_roundtrip():
+    spec = SLOSpec.from_dict({})
+    assert spec.is_noop
+    assert spec.window_ticks == 8 and spec.hysteresis_ticks == 2
+    assert spec.burn_fast == 2.0 and spec.burn_slow == 1.0
+    full = SLOSpec.from_dict({
+        "version": 1,
+        "window_ticks": 4,
+        "targets": {"min_clients_per_round": 2.0, "staleness_p95_max": 3.0},
+        "tenants": {"1": {"staleness_p95_max": 1.0}},
+    })
+    assert not full.is_noop
+    # to_dict -> from_dict is the identity on the parsed form
+    assert SLOSpec.from_dict(full.to_dict()) == full
+    # overrides replace key-by-key, globals fill the rest
+    assert full.effective_targets(0)["staleness_p95_max"] == 3.0
+    assert full.effective_targets(1) == {
+        "min_clients_per_round": 2.0, "staleness_p95_max": 1.0,
+    }
+
+
+@pytest.mark.parametrize("raw, code", [
+    (["not", "an", "object"], "slo-spec-syntax"),
+    ({"bogus_key": 1}, "slo-spec-syntax"),
+    ({"version": 2}, "slo-spec-syntax"),
+    ({"window_ticks": "four"}, "slo-spec-window-range"),
+    ({"window_ticks": 0}, "slo-spec-window-range"),
+    ({"fast_window_ticks": 4, "slow_window_ticks": 2},
+     "slo-spec-window-range"),
+    ({"burn_fast": 0.0}, "slo-spec-target-range"),
+    ({"targets": {"made_up_target": 1.0}}, "slo-spec-unknown-target"),
+    ({"targets": {"min_clients_per_round": True}}, "slo-spec-target-range"),
+    ({"targets": {"checksum_failure_budget": 0.0}}, "slo-spec-target-range"),
+    ({"targets": {"checksum_failure_budget": 1.5}}, "slo-spec-target-range"),
+    ({"targets": {"convergence_residency_min": 0.5}},
+     "slo-spec-target-range"),
+    ({"tenants": "nope"}, "slo-spec-tenant-override"),
+    ({"tenants": {"x": {}}}, "slo-spec-tenant-override"),
+    ({"tenants": {"-1": {}}}, "slo-spec-tenant-override"),
+    ({"tenants": {"0": {"made_up_target": 1.0}}},
+     "slo-spec-unknown-target"),
+])
+def test_spec_rejections(raw, code):
+    with pytest.raises(ConfigError) as ei:
+        SLOSpec.from_dict(raw)
+    assert reason_code_of(ei.value) == code
+
+
+def test_spec_load_errors(tmp_path):
+    with pytest.raises(ConfigError) as ei:
+        SLOSpec.load(tmp_path / "missing.json")
+    assert reason_code_of(ei.value) == "slo-spec-syntax"
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(ConfigError) as ei:
+        SLOSpec.load(bad)
+    assert reason_code_of(ei.value) == "slo-spec-syntax"
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"targets": {"buffer_fill_max": 4.0}}))
+    assert SLOSpec.load(good).targets == {"buffer_fill_max": 4.0}
+
+
+def test_spec_with_overrides():
+    spec = SLOSpec.from_dict({"targets": {"min_clients_per_round": 1.0}})
+    assert spec.with_overrides() is spec
+    tuned = spec.with_overrides(window_ticks=3, hysteresis_ticks=5)
+    assert (tuned.window_ticks, tuned.hysteresis_ticks) == (3, 5)
+    assert tuned.targets == spec.targets
+
+
+def test_config_rejects_engaged_slo_knobs_without_spec():
+    from deepreduce_tpu.config import DeepReduceConfig
+
+    with pytest.raises(ConfigError) as ei:
+        DeepReduceConfig(slo_window=4)
+    assert reason_code_of(ei.value) == "slo-knobs-disengaged"
+    with pytest.raises(ConfigError) as ei:
+        DeepReduceConfig(slo_spec="slo.json")
+    assert reason_code_of(ei.value) == "slo-needs-fed"
+
+
+# ---------------------------------------------------------------------- #
+# health.jsonl record + stream contracts
+# ---------------------------------------------------------------------- #
+
+
+def _rec(**kw):
+    base = dict(tick=4, tenant=0, window_ticks=2, from_state="OK",
+                to_state="DEGRADED", trigger="min_clients_per_round",
+                value=0.0, threshold=5.0, burn_fast=None, burn_slow=None)
+    base.update(kw)
+    return base
+
+
+def test_validate_health_accepts_canonical_records():
+    validate_health(_rec())
+    validate_health(_rec(from_state="DEGRADED", to_state="OK",
+                         trigger="recovered", value=None, threshold=None))
+    validate_health(_rec(trigger="checksum_failure_budget",
+                         value=0.2, threshold=0.1,
+                         burn_fast=2.0, burn_slow=1.5))
+
+
+@pytest.mark.parametrize("rec, match", [
+    ("not a dict", "must be a dict"),
+    (_rec(to_state="WEDGED"), "unknown health state"),
+    ({k: v for k, v in _rec().items() if k != "window_ticks"},
+     "missing=\\['window_ticks'\\]"),
+    (dict(_rec(), surprise=1), "extra=\\['surprise'\\]"),
+    (_rec(tick=True), "is bool"),
+    (_rec(tick=-1), "out of range"),
+    (_rec(window_ticks=0), "out of range"),
+    (_rec(value="high"), "has type str"),
+    (_rec(to_state="BREACH"), "exactly one rung"),
+    (_rec(trigger="recovered"), "downward transitions"),
+    (_rec(from_state="DEGRADED", to_state="OK"), "downward transitions"),
+    (_rec(trigger="made_up_trigger"), "unknown trigger"),
+])
+def test_validate_health_rejects(rec, match):
+    with pytest.raises(ValueError, match=match):
+        validate_health(rec)
+
+
+def test_validate_health_stream_contracts():
+    up = _rec(tick=2)
+    down = _rec(tick=5, from_state="DEGRADED", to_state="OK",
+                trigger="recovered", value=None, threshold=None)
+    validate_health_stream([up, down])
+    # per-tenant interleaving is fine: tenant streams chain independently
+    validate_health_stream([up, _rec(tick=2, tenant=1), down])
+    with pytest.raises(ValueError, match="non-monotonic tick"):
+        validate_health_stream([up, dict(down, tick=2)])
+    with pytest.raises(ValueError, match="broken transition chain"):
+        validate_health_stream([up, _rec(tick=9)])
+    with pytest.raises(ValueError, match="record 1: unknown trigger"):
+        validate_health_stream([up, dict(down, trigger="oops")])
+
+
+def test_health_log_append_rejects_tick_regression(tmp_path):
+    log = HealthLog(tmp_path / "health.jsonl")
+    log.append(_rec(tick=3))
+    with pytest.raises(ValueError, match="non-monotonic health tick"):
+        log.append(_rec(tick=3, from_state="DEGRADED", to_state="BREACH"))
+    log.append(_rec(tick=7, from_state="DEGRADED", to_state="BREACH"))
+    recs = HealthLog.read(tmp_path / "health.jsonl")
+    assert [r["tick"] for r in recs] == [3, 7]
+    validate_health_stream(recs)
+    assert HealthLog.read(tmp_path / "absent.jsonl") == []
+
+
+# ---------------------------------------------------------------------- #
+# the ladder: pinned escalation/recovery ticks, hysteresis, no storms
+# ---------------------------------------------------------------------- #
+
+
+def _ladder_spec(**kw):
+    base = dict(window_ticks=2, fast_window_ticks=1, slow_window_ticks=3,
+                hysteresis_ticks=2,
+                targets={"min_clients_per_round": 5.0})
+    base.update(kw)
+    return SLOSpec(**base)
+
+
+def test_monitor_escalation_and_recovery_ticks_pinned():
+    mon = HealthMonitor(_ladder_spec())
+    clients = [10, 10] + [0] * 5 + [10] * 4
+    events = []
+    for tick, c in enumerate(clients):
+        events += mon.observe(tick, {"clients": c})
+    # one rung per transition, hysteresis_ticks=2 consecutive evaluations
+    # each: OK->DEGRADED at 4, ->BREACH at 6, back down at 8 and 10
+    assert [(e["tick"], e["from_state"], e["to_state"]) for e in events] == [
+        (4, "OK", "DEGRADED"),
+        (6, "DEGRADED", "BREACH"),
+        (8, "BREACH", "DEGRADED"),
+        (10, "DEGRADED", "OK"),
+    ]
+    up = events[0]
+    assert up["trigger"] == "min_clients_per_round"
+    assert up["value"] == 0.0 and up["threshold"] == 5.0
+    assert events[2]["trigger"] == "recovered"
+    assert events[2]["value"] is None
+    validate_health_stream(mon.events)
+    assert mon.healthy() and mon.state_of() == "OK"
+    assert mon.final_states() == {0: "OK"}
+
+
+def test_monitor_flapping_emits_no_transition_storm():
+    # window/slow of 1 make every violated tick BREACH-grade on its own;
+    # the 2-tick hysteresis streak still never builds under alternation
+    mon = HealthMonitor(_ladder_spec(
+        window_ticks=1, slow_window_ticks=1, fast_window_ticks=1))
+    for tick in range(20):
+        mon.observe(tick, {"clients": 0 if tick % 2 == 0 else 10})
+    assert mon.events == []
+    assert mon.healthy()
+
+
+def test_monitor_rejects_non_monotonic_observe():
+    mon = HealthMonitor(_ladder_spec())
+    mon.observe(3, {"clients": 10})
+    with pytest.raises(ValueError, match="non-monotonic observe tick"):
+        mon.observe(3, {"clients": 10})
+    mon.observe(3, {"clients": 10}, tenant=1)  # other tenants unaffected
+
+
+def test_monitor_missing_data_is_level_zero():
+    # rows without the target's field carry no evidence: no transitions
+    mon = HealthMonitor(_ladder_spec())
+    for tick in range(6):
+        mon.observe(tick, {"buffer_fill": 999.0})
+    assert mon.events == [] and mon.healthy()
+    row = mon.verdict(0)["targets"]["min_clients_per_round"]
+    assert row["value"] is None and row["ok"]
+
+
+# ---------------------------------------------------------------------- #
+# error-budget burn rates (fast/slow windows)
+# ---------------------------------------------------------------------- #
+
+
+def _burn_spec():
+    return SLOSpec(window_ticks=4, fast_window_ticks=2, slow_window_ticks=4,
+                   hysteresis_ticks=1, burn_fast=2.0, burn_slow=1.0,
+                   targets={"checksum_failure_budget": 0.1})
+
+
+def test_burn_rate_fast_slow_window_math():
+    mon = HealthMonitor(_burn_spec())
+    events = []
+    # 4 ticks at 20% failures (burn 2x a 10% budget), then clean ticks
+    for tick in range(8):
+        rep = ({"clients": 8, "checksum_failures": 2} if tick < 4
+               else {"clients": 10, "checksum_failures": 0})
+        events += mon.observe(tick, rep)
+    assert [(e["tick"], e["to_state"], e["trigger"]) for e in events] == [
+        (0, "DEGRADED", "checksum_failure_budget"),  # slow burn >= 1x
+        (3, "BREACH", "checksum_failure_budget"),    # full slow window AND
+                                                     # fast burn >= 2x
+        (4, "DEGRADED", "recovered"),  # fast window cooled below 2x
+        (6, "OK", "recovered"),        # slow window burn fell below 1x
+    ]
+    breach = events[1]
+    assert breach["burn_fast"] == pytest.approx(2.0)
+    assert breach["burn_slow"] == pytest.approx(2.0)
+    # value is the observed failure fraction, threshold the budget
+    assert breach["value"] == pytest.approx(0.2)
+    assert breach["threshold"] == 0.1
+
+
+def test_burn_rate_needs_full_slow_window_for_breach():
+    # identical failure rate, but only 3 ticks: the slow window never
+    # fills, so the grade caps at DEGRADED no matter how hot the burn
+    mon = HealthMonitor(_burn_spec())
+    for tick in range(3):
+        mon.observe(tick, {"clients": 8, "checksum_failures": 2})
+    assert [e["to_state"] for e in mon.events] == ["DEGRADED"]
+    assert mon.state_of() == "DEGRADED"
+
+
+# ---------------------------------------------------------------------- #
+# staleness-histogram + per-tenant targets through the monitor
+# ---------------------------------------------------------------------- #
+
+
+def test_monitor_staleness_hist_and_tenant_overrides():
+    spec = SLOSpec(
+        window_ticks=1, fast_window_ticks=1, slow_window_ticks=1,
+        hysteresis_ticks=1,
+        targets={"staleness_p95_max": 2.0},
+        tenant_targets={1: {"staleness_p95_max": 0.5}},
+    )
+    mon = HealthMonitor(spec)
+    # hist [5,2,1]: cdf 0.625 / 0.875 / 1.0 -> p95 = level 2
+    for tick in range(2):
+        mon.observe(tick, {"staleness_hist": [5, 2, 1]}, tenant=0)
+        mon.observe(tick, {"staleness_hist": [5, 2, 1]}, tenant=1)
+    # tenant 0's ceiling (2.0) holds; tenant 1's override (0.5) breaches
+    assert mon.state_of(0) == "OK"
+    assert mon.state_of(1) == "BREACH"
+    assert not mon.healthy()
+    v = mon.verdict(1)["targets"]["staleness_p95_max"]
+    assert v["value"] == 2.0 and v["threshold"] == 0.5 and not v["ok"]
+
+
+# ---------------------------------------------------------------------- #
+# bitwise state_dict replay + the degenerate no-op
+# ---------------------------------------------------------------------- #
+
+
+def _feed(mon, ticks):
+    out = []
+    for tick in ticks:
+        c = 0 if 2 <= tick <= 6 else 10
+        out += mon.observe(tick, {"clients": c})
+    return out
+
+
+def test_state_dict_replay_is_bitwise():
+    a = HealthMonitor(_ladder_spec())
+    _feed(a, range(5))
+    snap = json.dumps(a.state_dict(), sort_keys=True)
+
+    b = HealthMonitor(_ladder_spec())
+    b.load_state_dict(json.loads(snap))
+    assert json.dumps(b.state_dict(), sort_keys=True) == snap
+
+    _feed(a, range(5, 12))
+    _feed(b, range(5, 12))
+    assert (json.dumps(a.state_dict(), sort_keys=True)
+            == json.dumps(b.state_dict(), sort_keys=True))
+    assert ([json.dumps(e, sort_keys=True) for e in a.events]
+            == [json.dumps(e, sort_keys=True) for e in b.events])
+    assert a.events  # the scenario actually transitions
+
+
+def test_degenerate_spec_is_a_provable_noop():
+    spec = SLOSpec.from_dict({"window_ticks": 3})
+    assert spec.is_noop
+    mon = HealthMonitor(spec)
+    before = json.dumps(mon.state_dict(), sort_keys=True)
+    for tick in range(10):
+        assert mon.observe(tick, {"clients": 0, "checksum_failures": 99,
+                                  "staleness_hist": [0, 0, 99]}) == []
+    assert json.dumps(mon.state_dict(), sort_keys=True) == before
+    assert mon.state_dict() == {"tenants": {}, "events": []}
+    assert mon.events == [] and mon.healthy()
+    # a spec whose only tenant override is empty is still target-less
+    assert SLOSpec.from_dict({"tenants": {"0": {}}}).is_noop
+
+
+def test_schema_key_tables_are_consistent():
+    # the schema fields the docs pin: exactly these keys, no drift
+    assert set(HEALTH_SCHEMA) == {
+        "tick", "tenant", "window_ticks", "from_state", "to_state",
+        "trigger", "value", "threshold", "burn_fast", "burn_slow",
+    }
+    assert HEALTH_STATES == ("OK", "DEGRADED", "BREACH")
+    assert set(TARGET_KEYS) == {
+        "min_clients_per_round", "min_clients_per_sec",
+        "staleness_p95_max", "buffer_fill_max", "checksum_failure_budget",
+        "convergence_band", "convergence_residency_min",
+    }

@@ -622,3 +622,215 @@ def test_run_log_emits_strict_json(tmp_path):
     rec = json.loads(lines[0])  # parses strictly
     assert rec["loss"] is None and rec["ok"] == 1.0
     assert json.loads((tmp_path / "strict" / "summary.json").read_text())["last"] is None
+
+
+# ---------------------------------------------------------------------- #
+# SLO health plane CLI surface (r23)
+# ---------------------------------------------------------------------- #
+
+
+def test_dist_percentiles_pinned():
+    """Sorted linear-interpolation quantiles, pinned on a fixed list —
+    p95/p99 interpolate between order statistics instead of snapping."""
+    d = cli._dist([float(v) for v in range(1, 11)])
+    assert d["n"] == 10 and d["min"] == 1.0 and d["max"] == 10.0
+    assert d["mean"] == pytest.approx(5.5)
+    assert d["p50"] == pytest.approx(5.5)
+    assert d["p90"] == pytest.approx(9.1)
+    assert d["p95"] == pytest.approx(9.55)
+    assert d["p99"] == pytest.approx(9.91)
+    assert cli._dist([7.0]) == {
+        "n": 1, "mean": 7.0, "p50": 7.0, "p90": 7.0, "p95": 7.0,
+        "p99": 7.0, "min": 7.0, "max": 7.0,
+    }
+    assert cli._dist([]) == {"n": 0}
+    # the human row renders the new tails
+    line = cli._fmt_dist(d)
+    assert "p95 9.55" in line and "p99 9.91" in line
+
+
+def test_mt_fedsim_rows_tolerate_ragged_tenant_rows():
+    """Regression: a run dir mixing tenant geometries logs `*_t` rows of
+    different lengths; slot stats must skip the short rows instead of
+    raising IndexError."""
+    hist = [
+        {"ts": 1000.0, "clients_t": [4.0, 6.0],
+         "staleness_mean_t": [1.0, 2.0], "staleness_max_t": [1.0, 2.0],
+         "staleness_hist_t": [[4.0, 0.0], [0.0, 6.0]],
+         "buffer_fill_t": [3.0, 5.0], "applied_t": [1.0, 1.0]},
+        # ragged: a single-tenant record in the same dir
+        {"ts": 1000.5, "clients_t": [4.0],
+         "staleness_mean_t": [3.0], "staleness_max_t": [5.0],
+         "staleness_hist_t": [[4.0]],
+         "buffer_fill_t": [7.0], "applied_t": [1.0]},
+        {"ts": 1001.0, "clients_t": [2.0, 8.0],
+         "staleness_mean_t": [1.0, 0.0], "staleness_max_t": [2.0, 1.0]},
+    ]
+    out = cli._mt_fedsim_rows(hist)
+    assert out["fed_tenants"] == 2
+    # slot means/maxes only over the rows that carry the slot
+    assert out["fed_mt_staleness_mean"][0] == pytest.approx(5.0 / 3)
+    assert out["fed_mt_staleness_mean"][1] == pytest.approx(1.0)
+    assert out["fed_mt_staleness_max"] == [5.0, 2.0]
+    # per-tenant tails from the summed [T, D] histogram rows
+    assert out["fed_mt_staleness_p95"] == [0.0, 1.0]
+    assert out["fed_mt_buffer_fill_per_apply"][0] == pytest.approx(5.0)
+
+
+def test_fedsim_report_staleness_tail_from_histogram():
+    hist = [
+        {"clients": 8, "uplink_bytes": 100.0, "downlink_bytes": 10.0,
+         "staleness_hist": [5.0, 2.0, 1.0]}
+        for _ in range(3)
+    ]
+    rep = cli._fedsim_report(hist)
+    assert rep["fed_staleness_hist_total"] == [15.0, 6.0, 3.0]
+    assert rep["fed_staleness_p50"] == 0.0
+    assert rep["fed_staleness_p95"] == 2.0
+    assert rep["fed_staleness_p99"] == 2.0
+
+
+def _write_fed_run(root, name, *, rows):
+    d = root / name
+    d.mkdir(parents=True)
+    (d / "config.json").write_text(
+        json.dumps({"name": name, "tags": [], "config": {}})
+    )
+    with open(d / "metrics.jsonl", "w") as f:
+        for rec in rows:
+            f.write(json.dumps(rec) + "\n")
+    (d / "summary.json").write_text(json.dumps({}))
+    return d
+
+
+def _fed_rows(n=6, clients=8, hist=(5.0, 2.0, 1.0)):
+    return [
+        {"round": i, "ts": 1000.0 + 0.1 * i, "clients": clients,
+         "checksum_failures": 0.0, "buffer_fill": 10.0, "w_rel_err": 0.5,
+         "staleness_hist": list(hist)}
+        for i in range(n)
+    ]
+
+
+def test_cli_slo_verdict_and_exit_gate(tmp_path, capsys):
+    run = _write_fed_run(tmp_path, "fed", rows=_fed_rows())
+    ok_spec = tmp_path / "ok.json"
+    ok_spec.write_text(json.dumps({
+        "window_ticks": 2, "hysteresis_ticks": 2,
+        "targets": {"min_clients_per_round": 1.0,
+                    "staleness_p95_max": 3.0},
+    }))
+    assert cli.main(["slo", str(run), "--spec", str(ok_spec)]) == 0
+    out = capsys.readouterr().out
+    assert "0 health transitions" in out and "tenant 0: OK" in out
+    assert "staleness_p95_max: 2 vs 3  ok" in out
+
+    # p95 of [5,2,1] is level 2 > the 0.5 ceiling: DEGRADED at tick 0,
+    # BREACH at tick 1, and the command exit-gates on it
+    breach_spec = tmp_path / "breach.json"
+    breach_spec.write_text(json.dumps({
+        "window_ticks": 1, "fast_window_ticks": 1, "slow_window_ticks": 1,
+        "hysteresis_ticks": 1,
+        "targets": {"staleness_p95_max": 0.5},
+    }))
+    assert cli.main(["slo", str(run), "--spec", str(breach_spec)]) == 1
+    cap = capsys.readouterr()
+    assert "OK -> DEGRADED" in cap.out and "DEGRADED -> BREACH" in cap.out
+    assert "BREACH" in cap.err
+
+    # --json carries events + verdicts and still gates
+    assert cli.main(
+        ["slo", str(run), "--spec", str(breach_spec), "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["verdicts"][0]["state"] == "BREACH"
+    assert [e["to_state"] for e in rep["events"]] == ["DEGRADED", "BREACH"]
+
+
+def test_cli_slo_degenerate_and_error_paths(tmp_path, capsys):
+    run = _write_fed_run(tmp_path, "fed", rows=_fed_rows())
+    noop = tmp_path / "noop.json"
+    noop.write_text(json.dumps({"window_ticks": 4}))
+    assert cli.main(["slo", str(run), "--spec", str(noop)]) == 0
+    assert "no-op" in capsys.readouterr().out
+    # malformed spec and non-fed run dirs are data errors (exit 2)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"targets": {"bogus": 1.0}}))
+    assert cli.main(["slo", str(run), "--spec", str(bad)]) == 2
+    plain = _write_run(tmp_path, "plain")
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"targets": {"min_clients_per_round": 1.0}}))
+    assert cli.main(["slo", str(plain), "--spec", str(ok)]) == 2
+
+
+def test_cli_slo_multi_tenant_overrides(tmp_path, capsys):
+    rows = [
+        {"round": i, "ts": 1000.0 + 0.1 * i, "clients_t": [8.0, 8.0],
+         "checksum_failures_t": [0.0, 0.0], "buffer_fill_t": [1.0, 1.0],
+         "w_rel_err_t": [0.1, 0.1],
+         "staleness_hist_t": [[8.0, 0.0, 0.0], [5.0, 2.0, 1.0]]}
+        for i in range(6)
+    ]
+    run = _write_fed_run(tmp_path, "mt", rows=rows)
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({
+        "window_ticks": 1, "fast_window_ticks": 1, "slow_window_ticks": 1,
+        "hysteresis_ticks": 1,
+        "targets": {"staleness_p95_max": 3.0},
+        "tenants": {"1": {"staleness_p95_max": 0.5}},
+    }))
+    # tenant 0 under the global ceiling, tenant 1 breaches its override
+    assert cli.main(["slo", str(run), "--spec", str(spec)]) == 1
+    out = capsys.readouterr().out
+    assert "tenant 0: OK" in out and "tenant 1: BREACH" in out
+
+
+def test_cli_bench_history_shapes_and_gate(tmp_path, capsys):
+    (tmp_path / "BENCH_MODERN_r07.json").write_text(json.dumps({
+        "metric": "t_round_s", "value": 0.25, "unit": "s",
+        "platform": "cpu",
+        "provenance": {"modeled": ["t_round_s"], "measured": ["clients"]},
+        "profile_sha256": "abcdef0123456789",
+    }))
+    (tmp_path / "BENCH_RAW_r02.json").write_text(json.dumps({
+        "cmd": "python bench.py", "rc": 0, "n": 8,
+        "parsed": {"metric": "img_s", "value": 120.0, "unit": "img/s"},
+        "platform": "cpu",
+    }))
+    (tmp_path / "BENCH_HEADLINE_r03.json").write_text(json.dumps({
+        "headline": {"metric": "t_step_s", "value": 0.5, "unit": "s"},
+        "platform": "tpu",
+    }))
+    assert cli.main(["bench-history", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "3 record(s)" in out
+    # ordered by round parsed from the filename
+    assert out.index("r02") < out.index("r03") < out.index("r07")
+    assert "modeled+measured" in out and "legacy" in out
+    assert "profile:abcdef012345" in out
+
+    assert cli.main(["bench-history", str(tmp_path), "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["round"] for r in rows] == [2, 3, 7]
+    assert rows[2]["provenance"] == "modeled+measured"
+    assert rows[0]["provenance"] == "legacy"
+
+    # a schema-less record poisons the ledger: exit 2
+    (tmp_path / "BENCH_JUNK_r99.json").write_text(json.dumps({"oops": 1}))
+    assert cli.main(["bench-history", str(tmp_path)]) == 2
+    (tmp_path / "BENCH_JUNK_r99.json").unlink()
+    # an empty dir is a data error too
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli.main(["bench-history", str(empty)]) == 2
+
+
+def test_cli_bench_history_committed_ledger(capsys):
+    """Every committed BENCH_*.json record must parse under one of the
+    three ledger shapes — the repo's own history is the fixture."""
+    import pathlib
+
+    root = pathlib.Path(cli.__file__).resolve().parents[2]
+    assert (root / "Makefile").exists()
+    assert cli.main(["bench-history", str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "record(s)" in out

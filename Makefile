@@ -130,6 +130,18 @@ overlap-check:
 		rd=lambda n:[(r['loss'],r['rel_volume']) for r in map(json.loads, open('$(OVERLAP_CHECK_DIR)/'+n+'/metrics.jsonl'))]; \
 		a,b=rd('stream'),rd('barrier'); \
 		sys.exit(0 if a==b and a else (print('overlap-check: metrics diverge',a,b),1)[1])"
+	# composed stream-over-hier run on the (2, 4) two-axis mesh: the gate
+	# takes the MINIMUM overlap fraction across the bucket wrapper and the
+	# nested exchange/dcn + exchange/ici leg spans, at the tighter 0.9
+	# threshold — every leg of every bucket must dispatch from inside
+	# backprop, not just the wrapper span
+	JAX_PLATFORMS=cpu python benchmarks/train.py --platform cpu \
+		--model mlp --num_steps 6 --batch_size 8 --num_workers 8 --seed 0 \
+		--telemetry --track_dir $(OVERLAP_CHECK_DIR) --run_name composed \
+		--log_every 0 \
+		--grace_config "{$(OVERLAP_CHECK_CFG),'stream_exchange':True,'hier':True}"
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry trace \
+		$(OVERLAP_CHECK_DIR)/composed --overlap --overlap-threshold 0.9
 
 # cost-model calibration gate: a short telemetry-on train on the
 # 8-worker CPU mesh writes a tracked run dir, then `telemetry calibrate`

@@ -1113,6 +1113,236 @@ def hier_sweep(quick: bool = False, n_slices: int = 8, per_slice: int = 4) -> di
     }
 
 
+def compose_sweep(quick: bool = False, n_slices: int = 8, per_slice: int = 4) -> dict:
+    """The composed-legs sweep arm (`--compose-sweep`): the stream-over-hier
+    schedule against its three parents — streaming-flat, barrier-hier, and
+    the flat fused baseline — at the LSTM census geometry.
+
+    Execution is real: all four arms run one grad+exchange step over the
+    scaled six-leaf census on the 8-device CPU mesh (flat arms on the
+    8-way axis, hier arms on the (2, 4) virtual two-axis mesh; the
+    streaming arms dispatch every bucket's collectives from inside the
+    custom_vjp backward hooks). The pricing grid is modeled at the
+    deployment shape (`n_slices` slices of `per_slice` devices, 100 Mbps
+    DCN / 10 Gbps ICI) with the SAME `costmodel.stream_hier_step_time`
+    the overlap-aware planner calls, swept over {ratio} x {hideable
+    compute}: the composed model hides the combined ici+dcn wire, the
+    streaming-flat parent hides the W-wide flat gather, the barrier
+    parents hide nothing — so every grid point prices what composing the
+    two legs actually buys."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from deepreduce_tpu.comm import GradientExchanger
+    from deepreduce_tpu.comm_stream import StreamingExchange
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.parallel.hierarchical import (
+        HierarchicalExchanger, make_hybrid_mesh,
+    )
+    from deepreduce_tpu.utils import enable_compile_cache
+    from deepreduce_tpu.utils.compat import shard_map
+
+    enable_compile_cache()
+    cm = _costmodel()
+    tmap = jax.tree_util.tree_map
+    d = LSTM_D
+    ratio = 0.10  # the paper's Top-r 10% LSTM setting
+    W = n_slices * per_slice
+
+    # -- real execution: the six-leaf census (one embedding-style leaf that
+    # buckets solo plus five gate/bias-style leaves) scaled so the FFD
+    # partition keeps its three-bucket structure
+    scale = 16 if quick else 64
+    census = {
+        "emb": 3000 * scale, "w1": 900 * scale, "w2": 700 * scale,
+        "b1": 300 * scale, "b2": 150 * scale, "b3": 50 * scale,
+    }
+    bucket_bytes = 4800 * scale
+    codec_kw = dict(
+        deepreduce="index", index="bloom", bloom_blocked="mod",
+        compress_ratio=ratio, fpr=0.01, min_compress_size=100,
+        memory="residual", decode_strategy="loop",
+    )
+    arm_cfgs = {
+        "flat": DeepReduceConfig(bucket_bytes=bucket_bytes, **codec_kw),
+        "stream-flat": DeepReduceConfig(
+            bucket_bytes=bucket_bytes, stream_exchange=True, **codec_kw
+        ),
+        "barrier-hier": DeepReduceConfig(
+            bucket_bytes=bucket_bytes, hier=True, **codec_kw
+        ),
+        "stream-hier": DeepReduceConfig(
+            bucket_bytes=bucket_bytes, stream_exchange=True, hier=True,
+            **codec_kw
+        ),
+    }
+    rng = np.random.default_rng(0)
+    params = {
+        n: jnp.asarray(rng.normal(size=sz).astype(np.float32))
+        for n, sz in census.items()
+    }
+    batch_w = {
+        n: jnp.asarray(
+            (rng.normal(size=(8, sz)) * rng.random((8, sz)) ** 2).astype(
+                np.float32
+            )
+        )
+        for n, sz in census.items()
+    }
+    res_w = tmap(lambda b: jnp.zeros_like(b), batch_w)
+    flat_mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    hier_mesh = make_hybrid_mesh(2, 4)
+
+    def loss_fn(p, batch_stats, batch):
+        # linear-in-params probe: each leaf's cotangent is its batch row,
+        # so the hooks see ordinary per-worker gradients
+        loss = sum(jnp.sum(pv * batch[n]) for n, pv in p.items())
+        return loss, batch_stats
+
+    measured = {}
+    for name, cfg in arm_cfgs.items():
+        hier = cfg.hier
+        if hier:
+            ex = HierarchicalExchanger(
+                tmap(lambda pv: jax.ShapeDtypeStruct(pv.shape, pv.dtype),
+                     params),
+                cfg, num_slices=2, per_slice=4,
+            )
+            mesh, spec = hier_mesh, P(("dcn", "ici"))
+        else:
+            ex = GradientExchanger(
+                tmap(lambda pv: jax.ShapeDtypeStruct(pv.shape, pv.dtype),
+                     params),
+                cfg, axis_name="data", num_workers=8,
+            )
+            mesh, spec = flat_mesh, P("data")
+        if cfg.stream_exchange:
+            stream = StreamingExchange(ex)
+
+            def spmd(p, b, res, step, _s=stream):
+                b0 = tmap(lambda x: x[0], b)
+                res0 = tmap(lambda r: r[0], res)
+                _, _, agg, new_res, _ = _s.value_and_grad_exchange(
+                    loss_fn, p, {}, b0, res0, step=step
+                )
+                return (
+                    tmap(lambda x: x[None], agg),
+                    tmap(lambda r: r[None], new_res),
+                )
+        else:
+
+            def spmd(p, b, res, step, _ex=ex):
+                b0 = tmap(lambda x: x[0], b)
+                res0 = tmap(lambda r: r[0], res)
+                grads = jax.grad(
+                    lambda pp: loss_fn(pp, {}, b0)[0]
+                )(p)
+                agg, new_res, _ = _ex.exchange(grads, res0, step=step)
+                return (
+                    tmap(lambda x: x[None], agg),
+                    tmap(lambda r: r[None], new_res),
+                )
+
+        fn = jax.jit(
+            shard_map(
+                spmd, mesh=mesh, in_specs=(P(), spec, spec, P()),
+                out_specs=(spec, spec), check_vma=False,
+            )
+        )
+        step0 = jnp.zeros((), jnp.int32)
+        _progress(f"compose-sweep: compiling {name}")
+        with _span(f"bench/compose-sweep/compile/{name}"):
+            _sync(fn(params, batch_w, res_w, step0))
+        _progress(f"compose-sweep: timing {name}")
+        with _span(f"bench/compose-sweep/time/{name}"):
+            wall = _timeit(fn, params, batch_w, res_w, step0,
+                           iters=2, reps=3)
+        measured[name] = {
+            "wall_s": round(wall, 4),
+            "compute_s_per_worker": round(wall / 8, 4),
+        }
+        _progress(f"compose-sweep: {name} wall={wall:.4f}s")
+
+    # -- modeled pricing grid at the deployment shape: the composed model
+    # against min(parents) over {ratio} x {hideable compute} --
+    anchor = measured["stream-hier"]["compute_s_per_worker"]
+    ratios = (0.02, 0.05, 0.10)
+    points = []
+    wins = 0
+    for r in ratios:
+        m = {
+            "payload_bytes": 8.0 * max(1, int(d * r)),
+            "t_encode_s": 0.0, "t_decode_s": 0.0,
+        }
+        for ct in (0.0, anchor, 4.0 * anchor):
+            flat_t = cm.fused_step_time(m, W)
+            stream_flat_t = cm.overlapped_step_time(m, W, compute_time=ct)
+            barrier_hier_t = cm.hier_step_time(
+                "dense", "bucketed", d, n_slices, per_slice, r
+            )
+            composed_t = cm.stream_hier_step_time(
+                "bucketed", d, n_slices, per_slice, r, compute_time=ct
+            )
+            le_parents = bool(
+                composed_t <= min(stream_flat_t, barrier_hier_t) + 1e-12
+            )
+            wins += le_parents
+            points.append({
+                "ratio": r,
+                "compute_time_s": round(ct, 4),
+                "flat_s": round(flat_t, 4),
+                "stream_flat_s": round(stream_flat_t, 4),
+                "barrier_hier_s": round(barrier_hier_t, 4),
+                "composed_s": round(composed_t, 4),
+                "composed_le_min_parents": le_parents,
+            })
+    plan = cm.select_hier_plan(
+        d, n_slices, per_slice, ratio, stream=True, compute_time=anchor,
+        dcn_legs=("fused", "bucketed"),
+    )
+    return {
+        "metric": "composed_stream_hier_step_time_vs_parents",
+        "unit": "s",
+        "platform": "cpu",
+        "provenance": _provenance(
+            modeled=["points", "overlap_aware_plan"],
+            measured=["measured_virtual_mesh"],
+        ),
+        "detail": {
+            "model": "stackoverflow_lstm" if not quick else "quick",
+            "d": d,
+            "ratio": ratio,
+            "n_slices": n_slices,
+            "per_slice": per_slice,
+            "census_elements": int(sum(census.values())),
+            "bucket_bytes": bucket_bytes,
+            "bw_dcn_bytes_per_s": cm.BW_100MBPS,
+            "bw_ici_bytes_per_s": cm.BW_ICI_10GBPS,
+            "cost_model": (
+                "composed overlap model (costmodel.stream_hier_step_time: "
+                "hideable compute shaves the combined ici+dcn wire) vs the "
+                "streaming-flat (overlapped_step_time, W-wide gather) and "
+                "barrier-hier (hier_step_time, nothing hidden) parents; "
+                "execution measured on the 8-device CPU mesh"
+            ),
+            "measured_virtual_mesh": measured,
+            "points": points,
+            "headline": {
+                "composed_le_min_parents": f"{wins}/{len(points)}",
+                "grid_points": len(points),
+            },
+            "overlap_aware_plan": {
+                "ici": plan["ici"],
+                "dcn": plan["dcn"],
+                "modeled_step_s": round(plan["modeled_step_s"], 4),
+                "compute_time_s": round(anchor, 4),
+            },
+        },
+    }
+
+
 def fed_sweep(quick: bool = False, workers: int = 8) -> dict:
     """The federated serving sweep arm (`--fed-sweep`): the client-sharded
     `fedsim` round on the virtual 8-way CPU mesh, swept over cohort sizes
@@ -1892,6 +2122,14 @@ def main() -> None:
 
         force_platform("cpu")
         print(json.dumps(hier_sweep(quick="--quick" in sys.argv)))
+        return
+    if "--compose-sweep" in sys.argv:
+        # standalone composed-legs sweep: CPU-mesh only, one JSON record on
+        # stdout (committed as BENCH_COMPOSE_*.json)
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu", device_count=8)
+        print(json.dumps(compose_sweep(quick="--quick" in sys.argv)))
         return
     if "--fed-sweep" in sys.argv:
         # standalone federated serving sweep: CPU-mesh only, one JSON

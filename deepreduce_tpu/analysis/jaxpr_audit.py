@@ -347,9 +347,7 @@ def audit_exchange(
     pb = ex.payload_bytes(grads_like) if wire_mode is not None else None
     g_w = tmap(lambda s: _sds((NUM_WORKERS,) + s.shape), grads_like)
 
-    if with_mask and not with_state:
-        raise ValueError("with_mask audits require memory='residual'")
-    if with_mask:
+    if with_mask and with_state:
 
         def spmd(g, res, step, m):
             g0 = tmap(lambda x: x[0], g)
@@ -362,6 +360,19 @@ def audit_exchange(
             spmd, mesh, (P(AXIS), P(AXIS), P(), P()), (P(AXIS), P(AXIS))
         )
         args = (g_w, g_w, _STEP, _sds((NUM_WORKERS,), jnp.bool_))
+    elif with_mask:
+        # the stateless masked shape: the resilient sparse_rs routes run
+        # memory='none' (their EF residual lives inside the route itself)
+        # but still thread the replicated live mask
+
+        def spmd(g, step, m):
+            agg, _, _ = ex.exchange(
+                tmap(lambda x: x[0], g), None, step=step, mask=m
+            )
+            return tmap(lambda x: x[None], agg)
+
+        fn = _shard_map(spmd, mesh, (P(AXIS), P(), P()), P(AXIS))
+        args = (g_w, _STEP, _sds((NUM_WORKERS,), jnp.bool_))
     elif with_state:
 
         def spmd(g, res, step):
@@ -986,6 +997,81 @@ def audit_streaming_exchange() -> List[TraceRecord]:
     return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
 
 
+def audit_streaming_hier_exchange() -> List[TraceRecord]:
+    """The composed stream-over-hier schedule (cfg.stream_exchange AND
+    cfg.hier): trace one streamed grad+exchange step where the
+    StreamingExchange wraps a HierarchicalExchanger on the (2, 4)
+    two-axis mesh.
+
+    The per-axis inventory pins the composition: each bucket's dense
+    slice-mean psum rides ici and its compressed gather rides dcn —
+    exactly _BUCKET_COUNT of each, nothing else anywhere.  Wire
+    accounting runs dcn-filtered against the DCN-only payload_bytes()
+    (the ici leg is accounted separately via WireStats.ici_bits), and
+    token dominance still contracts exactly two optimization barriers
+    per bucket: the ici psum runs INSIDE each bucket's barrier bracket
+    via the pre_encode hook, so the barrier count is the barrier
+    schedule's, unchanged."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepreduce_tpu.comm_stream import StreamingExchange
+    from deepreduce_tpu.parallel.hierarchical import HierarchicalExchanger
+
+    label = "exchange:stream-hier"
+    tmap = jax.tree_util.tree_map
+    n_slices, per_slice = 2, 4
+    mesh = audit_hier_mesh(n_slices, per_slice)
+    cfg = DeepReduceConfig(
+        memory="residual", decode_strategy="loop",
+        bucket_bytes=_BUCKET_BYTES, stream_exchange=True, hier=True,
+        **_FLAGSHIP
+    )
+    grads_like = {n: _sds((int(sz),)) for n, sz in _BUCKET_LEAVES.items()}
+    ex = HierarchicalExchanger(
+        grads_like, cfg, num_slices=n_slices, per_slice=per_slice
+    )
+    stream = StreamingExchange(ex)
+    n_buckets = len(ex.exchanger._bucketed.codecs)
+    pb = ex.payload_bytes(grads_like)
+    w = n_slices * per_slice
+    g_w = tmap(lambda s: _sds((w,) + s.shape), grads_like)
+
+    def loss_fn(params, batch_stats, batch):
+        loss = sum(jnp.sum(p * batch[n]) for n, p in params.items())
+        return loss, batch_stats
+
+    def spmd(p, b_w, res, step):
+        b0 = tmap(lambda x: x[0], b_w)
+        res0 = tmap(lambda r: r[0], res)
+        _, _, agg, new_res, _ = stream.value_and_grad_exchange(
+            loss_fn, p, {}, b0, res0, step=step
+        )
+        new_res = tmap(lambda r: r[None], new_res)
+        return tmap(lambda x: x[None], agg), new_res
+
+    spec_p = P(("dcn", "ici"))
+    fn = _shard_map(
+        spmd, mesh, (P(), spec_p, spec_p, P()), (spec_p, spec_p)
+    )
+    args = (grads_like, g_w, g_w, _STEP)
+    ctx = AuditContext(
+        label=label,
+        allow_callbacks=False,
+        expect_collectives_by_axis={
+            "ici": {"psum": n_buckets},
+            "dcn": {"all_gather": n_buckets},
+        },
+        wire_mode="allgather",
+        wire_axis="dcn",
+        expected_wire_bytes=pb,
+        num_workers=n_slices,
+        expect_codec_invocations=_BUCKET_COUNT,
+        expect_stream_buckets=n_buckets,
+        require_key_lineage=True,
+    )
+    return [trace_and_check(label, fn, args, ctx, payload_bytes=pb)]
+
+
 def audit_calib_reselect() -> List[TraceRecord]:
     """The calibration no-op contract (jx-calib-reselect), in two halves.
 
@@ -1522,6 +1608,57 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
     # bytes linear in T (registered last so the pre-existing record order —
     # and ANALYSIS.json hashes — are stable) ---
     add("fedsim:multi-tenant", lambda: audit_fedsim_multitenant())
+    # --- the r24 composed legs (registered last so the pre-existing record
+    # order — and ANALYSIS.json hashes — are stable) ---
+    # stream-over-hier: each bucket's ici psum + dcn gather dispatched from
+    # inside the bucket's backward hook, two barriers per bucket unchanged
+    add("exchange:stream-hier", lambda: audit_streaming_hier_exchange())
+    # the re-owned resilient sparse_rs routes: the live mask threads through
+    # the exchange without changing the collective skeleton (sparse) or
+    # adding more than the one int8 shard re-broadcast (quantized, whose
+    # masked wire grows by exactly n/W bytes — pinned by the byte audit
+    # against rs_payload_bytes(..., masked=True))
+    add(
+        "exchange:sparse_rs-sparse-masked",
+        lambda: audit_exchange(
+            "exchange:sparse_rs-sparse-masked",
+            C(communicator="sparse_rs", compressor="topk", memory="none",
+              deepreduce=None, compress_ratio=0.02, rs_mode="sparse",
+              resilience=True),
+            expect={"all_to_all": 1, "all_gather": 1},
+            wire_mode="collective",
+            with_mask=True,
+        ),
+    )
+    add(
+        "exchange:sparse_rs-quantized-masked",
+        lambda: audit_exchange(
+            "exchange:sparse_rs-quantized-masked",
+            C(communicator="sparse_rs", compressor="topk", memory="none",
+              deepreduce=None, compress_ratio=0.02, rs_mode="quantized",
+              resilience=True),
+            # the flat quantized inventory plus ONE extra int8 all_gather:
+            # every worker re-broadcasts its summed shard so deputies can
+            # dequantize and re-own a dropped worker's slice
+            expect={"pmax": 1, "reduce_scatter": 1, "all_gather": 2},
+            wire_mode="collective",
+            with_mask=True,
+        ),
+    )
+    add(
+        "exchange:sparse_rs-oktopk-masked",
+        lambda: audit_exchange(
+            "exchange:sparse_rs-oktopk-masked",
+            C(communicator="sparse_rs", compressor="topk", memory="none",
+              deepreduce=None, compress_ratio=0.02, rs_mode="oktopk",
+              resilience=True),
+            # masked oktopk zeroes dropped histogram weights before the
+            # psum and re-owns on the route — wire layout unchanged
+            expect={"psum": 1, "all_to_all": 1, "all_gather": 1},
+            wire_mode="collective",
+            with_mask=True,
+        ),
+    )
     return specs
 
 

@@ -133,7 +133,16 @@ def cell_kwargs(cell: Dict[str, str]) -> Dict[str, Any]:
         elif cell["hier"] == "auto_dcn":
             kw["hier_dcn"] = "auto"
     if cell["resilience"] == "on":
-        kw.update(resilience=True, payload_checksum=True, chaos_corrupt_rate=0.2)
+        if comm == "sparse_rs":
+            # the reduce-scatter routes thread the live mask through shard
+            # re-ownership but have no fused PayloadLayout — checksum/chaos
+            # are allgather-wire knobs and would (correctly) be refused by
+            # checksum-needs-fused-allgather, which is not this axis's fact
+            kw.update(resilience=True)
+        else:
+            kw.update(
+                resilience=True, payload_checksum=True, chaos_corrupt_rate=0.2
+            )
     if cell["ctrl"] == "on":
         kw.update(ctrl=True, telemetry=True, ctrl_ladder=_CTRL_LADDER)
     if cell["fed"] == "on":
@@ -216,7 +225,14 @@ def _trace_stream(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
     """The streaming grad+exchange harness, parametrized over cfg (the
     fixed audit hardcodes the flagship config): trace
     StreamingExchange.value_and_grad_exchange over the bucketed census
-    with the token-dominance rule armed at the actual bucket count."""
+    with the token-dominance rule armed at the actual bucket count.
+
+    On the hier != off plane the StreamingExchange wraps a
+    HierarchicalExchanger on the (dcn, ici) mesh: the per-axis inventory
+    pins each bucket's ICI slice-mean psum to ici and its compressed
+    gather to dcn, wire accounting runs dcn-filtered against the DCN-only
+    payload_bytes(), and token dominance still contracts exactly two
+    barriers per bucket — the ici psum rides inside the bracket."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -227,17 +243,41 @@ def _trace_stream(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
     from deepreduce_tpu.comm_stream import StreamingExchange
 
     tmap = jax.tree_util.tree_map
-    mesh = ja.audit_mesh()
+    hier = cell["hier"] != "off"
     grads_like = {
         n: ja._sds((int(sz),)) for n, sz in ja._BUCKET_LEAVES.items()
     }
-    ex = GradientExchanger(
-        grads_like, cfg, axis_name=ja.AXIS, num_workers=ja.NUM_WORKERS
-    )
-    stream = StreamingExchange(ex)
-    n_buckets = len(ex._bucketed.codecs)
+    if hier:
+        from deepreduce_tpu.parallel.hierarchical import HierarchicalExchanger
+
+        n_slices, per_slice = 2, 4
+        mesh = ja.audit_hier_mesh(n_slices, per_slice)
+        axes = ("dcn", "ici")
+        w = n_slices * per_slice
+        ex = HierarchicalExchanger(
+            grads_like, cfg, num_slices=n_slices, per_slice=per_slice
+        )
+        stream = StreamingExchange(ex)
+        n_buckets = len(ex.exchanger._bucketed.codecs)
+        num_workers = n_slices
+        spec_p, wire_axis = P(axes), "dcn"
+        expect_by_axis = {
+            "ici": {"psum": n_buckets},
+            "dcn": {"all_gather": n_buckets},
+        }
+    else:
+        mesh = ja.audit_mesh()
+        w = ja.NUM_WORKERS
+        ex = GradientExchanger(
+            grads_like, cfg, axis_name=ja.AXIS, num_workers=ja.NUM_WORKERS
+        )
+        stream = StreamingExchange(ex)
+        n_buckets = len(ex._bucketed.codecs)
+        num_workers = ja.NUM_WORKERS
+        spec_p, wire_axis = P(ja.AXIS), None
+        expect_by_axis = None
     pb = ex.payload_bytes(grads_like)
-    g_w = tmap(lambda s: ja._sds((ja.NUM_WORKERS,) + s.shape), grads_like)
+    g_w = tmap(lambda s: ja._sds((w,) + s.shape), grads_like)
 
     def loss_fn(params, batch_stats, batch):
         loss = sum(jnp.sum(p * batch[n]) for n, p in params.items())
@@ -253,14 +293,16 @@ def _trace_stream(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
         return tmap(lambda x: x[None], agg), new_res
 
     fn = ja._shard_map(
-        spmd, mesh, (P(), P(ja.AXIS), P(ja.AXIS), P()), (P(ja.AXIS), P(ja.AXIS))
+        spmd, mesh, (P(), spec_p, spec_p, P()), (spec_p, spec_p)
     )
     args = (grads_like, g_w, g_w, ja._STEP)
     ctx = AuditContext(
         label=label,
         wire_mode="allgather",
         expected_wire_bytes=pb,
-        num_workers=ja.NUM_WORKERS,
+        wire_axis=wire_axis,
+        expect_collectives_by_axis=expect_by_axis,
+        num_workers=num_workers,
         expect_stream_buckets=n_buckets,
         require_key_lineage=True,
     )

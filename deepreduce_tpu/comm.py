@@ -300,6 +300,12 @@ class GradientExchanger:
                 int(math.prod(l.shape)) if l.shape else 1
                 for l in jax.tree_util.tree_leaves(grads_like)
             )
+            # under resilience only the re-ownable routes are candidates —
+            # adaptive lane switches and sketch rows are per-worker wire
+            # state no deputy can serve (config fences them explicitly)
+            rs_candidates = (
+                ("sparse", "quantized", "oktopk") if cfg.resilience else None
+            )
             self._rs_mode = costmodel.select_rs_mode(
                 d,
                 num_workers,
@@ -312,6 +318,7 @@ class GradientExchanger:
                 bins=cfg.rs_oktopk_bins,
                 cap_headroom=cfg.rs_oktopk_cap_headroom,
                 profile=profile,
+                modes=rs_candidates,
             )
         leaves, self.treedef = jax.tree_util.tree_flatten_with_path(grads_like)
         self.names = [_leaf_name(path) for path, _ in leaves]
@@ -466,15 +473,15 @@ class GradientExchanger:
         decode is zeroed too, so its residual EF accumulator retains the
         un-sent gradient mass for re-delivery on rejoin."""
         cfg = self.cfg
-        if mask is not None and cfg.communicator in ("qar", "sparse_rs"):
+        if mask is not None and cfg.communicator == "qar":
             raise ValueError(
-                f"participation masks renormalize the decode-side mean of the "
-                f"allgather/allreduce paths; communicator={cfg.communicator!r} "
-                "reduces inside the collective, where every worker OWNS a "
-                "universe shard via static all_to_all/psum_scatter routing — "
-                "a masked-out worker's shard would black-hole for everyone "
+                "participation masks renormalize the decode-side mean of the "
+                "allgather/allreduce paths and re-own reduce-scatter shards "
+                "on the sparse_rs routes; communicator='qar' folds the mean "
+                "into one int8 psum_scatter with no per-worker decode row to "
+                "zero — a masked-out worker's levels are already summed "
                 "(see DeepReduceConfig.__post_init__) — use "
-                "communicator='allgather' or 'allreduce'"
+                "communicator='allgather', 'allreduce', or 'sparse_rs'"
             )
         num_workers = jax.lax.psum(1, self.axis_name)
         if collect is not None:
@@ -497,7 +504,7 @@ class GradientExchanger:
             return self._exchange_qar(grads, state, step=step, key=key)
         if cfg.communicator == "sparse_rs":
             return self._exchange_sparse_rs(
-                grads, state, step=step, key=key, collect=collect
+                grads, state, step=step, key=key, collect=collect, mask=mask
             )
 
         if cfg.communicator == "allreduce" or cfg.deepreduce is None and cfg.compressor == "none":
@@ -813,6 +820,7 @@ class GradientExchanger:
         step: jax.Array,
         key: Optional[jax.Array],
         collect: Optional[dict] = None,
+        mask: Optional[jax.Array] = None,
     ) -> Tuple[Any, Any, WireStats]:
         """Compressed in-collective allreduce (sparse_rs.py — the Ok-Topk /
         SparCML collective shape, with the adaptive/quantized/sketch routes
@@ -822,7 +830,10 @@ class GradientExchanger:
         the sketch route) instead of the allgather path's O(W·k). Residual
         error feedback covers send-side truncation (and quantization/
         sketch noise in those routes; sub-threshold and capacity-spilled
-        mass in the oktopk route)."""
+        mass in the oktopk route). `mask` (replicated bool[W]) selects the
+        live-mask-aware variants: shard ownership re-assigned over the
+        live set by a traced permutation, mean renormalized by the live
+        count (sparse_rs.owner_permutation)."""
         from deepreduce_tpu import sparse_rs
         from jax.flatten_util import ravel_pytree
 
@@ -872,6 +883,7 @@ class GradientExchanger:
                 oktopk_cap_headroom=cfg.rs_oktopk_cap_headroom,
                 key=key,
                 collect=collect,
+                mask=mask,
             )
         with spans.span("exchange/decode", route=rs_mode):
             agg = unravel(mean.astype(flat.dtype))
@@ -982,6 +994,7 @@ class GradientExchanger:
                     cols=self.cfg.rs_sketch_cols,
                     bins=self.cfg.rs_oktopk_bins,
                     cap_headroom=self.cfg.rs_oktopk_cap_headroom,
+                    masked=self.cfg.resilience,
                 )
             )
         if self._bucketed is not None:

@@ -40,6 +40,7 @@ shared `decode_gathered_loop` / `decode_gathered_vmap` machinery.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Dict, List, Sequence, Tuple
 
@@ -460,6 +461,7 @@ class BucketedExchanger:
         *,
         need_own: bool,
         token,
+        pre_encode=None,
     ):
         """One bucket of the STREAMING schedule (comm_stream.py): the same
         encode → pack → all_gather → decode a barrier/pipeline bucket runs,
@@ -471,10 +473,21 @@ class BucketedExchanger:
         `lax.optimization_barrier` is value-identity, so the pinning moves
         only the schedule, never the numbers.
 
-        Returns ``(total, own, stats, payload, token)`` — the pre-division
-        decode sum over workers, this worker's own decode (None unless
-        ``need_own``), the bucket's WireStats, its payload (for fp_stats),
-        and the chained token.
+        ``pre_encode`` is the composable upstream leg slot (comm_stream's
+        hierarchical composition): a callable applied to the concatenated
+        bucket AFTER the entry barrier and BEFORE encode, so a leg it
+        dispatches (the ICI slice-mean psum) is ordered on the same token
+        chain as this bucket's gather — per-axis collective order stays
+        pinned, still exactly two barriers per bucket. When set, the
+        bucket's remaining DCN half is wrapped in the ``exchange/dcn``
+        span (the composed runs' overlap-attribution hook); the flat
+        schedule's span structure is untouched.
+
+        Returns ``(total, own, stats, payload, token, dense)`` — the
+        pre-division decode sum over workers, this worker's own decode
+        (None unless ``need_own``), the bucket's WireStats, its payload
+        (for fp_stats), the chained token, and the encoded (post-
+        ``pre_encode``) dense bucket the residual update needs.
         """
         if self._chaos is not None or self._checksum:
             raise ValueError(
@@ -486,20 +499,28 @@ class BucketedExchanger:
         with spans.span(f"exchange/bucket/{spec.label}"):
             dense = self.concat_bucket(flat_grads, spec)
             dense, token = jax.lax.optimization_barrier((dense, token))
-            with spans.span("exchange/encode", route="bucketed"):
-                key = per_tensor_key(worker_key, spec.label, step)
-                payload = codec.encode(dense, step=step, key=key)
-                stats = codec.wire_stats(payload)
-            with spans.span("exchange/pack", route="bucketed"):
-                buf = self.layouts[spec.label].pack(payload)
-            with spans.span("exchange/allgather", route="bucketed"):
-                gathered = jax.lax.all_gather(buf, self.axis_name)
-            gathered, token = jax.lax.optimization_barrier((gathered, token))
-            with spans.span("exchange/decode", route="bucketed"):
-                total, own, _fails = self._decode_bucket(
-                    spec, gathered, num_workers, step, need_own=need_own
-                )
-        return total, own, stats, payload, token
+            if pre_encode is not None:
+                dense = pre_encode(dense)
+            dcn_span = (
+                spans.span("exchange/dcn")
+                if pre_encode is not None
+                else contextlib.nullcontext()
+            )
+            with dcn_span:
+                with spans.span("exchange/encode", route="bucketed"):
+                    key = per_tensor_key(worker_key, spec.label, step)
+                    payload = codec.encode(dense, step=step, key=key)
+                    stats = codec.wire_stats(payload)
+                with spans.span("exchange/pack", route="bucketed"):
+                    buf = self.layouts[spec.label].pack(payload)
+                with spans.span("exchange/allgather", route="bucketed"):
+                    gathered = jax.lax.all_gather(buf, self.axis_name)
+                gathered, token = jax.lax.optimization_barrier((gathered, token))
+                with spans.span("exchange/decode", route="bucketed"):
+                    total, own, _fails = self._decode_bucket(
+                        spec, gathered, num_workers, step, need_own=need_own
+                    )
+        return total, own, stats, payload, token, dense
 
     def saturation_vector(self, stats_per: Dict[str, WireStats]) -> jax.Array:
         """f32[C] per-bucket saturation flags in spec order — the telemetry

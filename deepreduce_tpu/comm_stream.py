@@ -46,13 +46,29 @@ Mechanics worth knowing before editing:
   closed-over tracers); being integer-dtype primals their cotangents are
   ``float0`` zeros.
 
+* **Hierarchical composition.** Constructed over a
+  ``HierarchicalExchanger`` (dense ICI leg, config-pinned bucketed DCN
+  leg — the shape config's narrowed ``stream-vs-hier`` fence admits),
+  each hook's backward rule runs its bucket's ICI slice-mean psum AND its
+  compressed DCN gather: the psum rides the bucket's ``pre_encode`` slot
+  between the entry barrier and the encode, so the one token chain pins
+  per-AXIS collective order (bucket b+1's ici psum cannot be hoisted
+  above bucket b's dcn gather) with still exactly two barriers per
+  bucket. ``psum(concat(leaves)) == concat(psum(leaves))`` elementwise,
+  so the streamed step stays bitwise-equal to the barrier-scheduled
+  `HierarchicalExchanger.exchange` (tests/test_streaming.py pins this
+  too); `WireStats.ici_bits` and the caller-key ici repair gather follow
+  the barrier path's arithmetic exactly.
+
 What does NOT compose (rejected loudly in config.__post_init__):
-resilience (mask/chaos/checksum state has no per-hook threading), hier
-(its two-leg slice schedule owns the whole pytree), fed. A flat streaming
-exchange over a multi-axis mesh via a tuple ``axis_name`` works and is
-covered by tests.
+resilience (mask/chaos/checksum state has no per-hook threading), the
+qar ICI leg and auto-rewritten DCN routes (they restructure the legs the
+hooks captured), fed. A flat streaming exchange over a multi-axis mesh
+via a tuple ``axis_name`` works and is covered by tests.
 """
 from __future__ import annotations
+
+import dataclasses
 
 from typing import Any, Callable, Dict, Optional
 
@@ -61,6 +77,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepreduce_tpu.metrics import combine
+from deepreduce_tpu.telemetry import spans
 
 
 def _float0_zeros(x):
@@ -76,16 +93,31 @@ class StreamingExchange:
     """
 
     def __init__(self, exchanger):
-        if exchanger._bucketed is None:
+        # composable-leg detection: a HierarchicalExchanger wraps the inner
+        # DCN-leg GradientExchanger and names the ici axis its hooks must
+        # reduce over (duck-typed so this module stays import-cycle-free)
+        self.hier = None
+        inner = exchanger
+        if hasattr(exchanger, "ici_axis") and hasattr(exchanger, "exchanger"):
+            self.hier = exchanger
+            inner = exchanger.exchanger
+            if exchanger.ici_leg != "dense":
+                raise ValueError(
+                    "StreamingExchange over a HierarchicalExchanger "
+                    "requires the dense ICI leg — the qar leg's two-phase "
+                    "quantized allreduce cannot split per bucket hook "
+                    f"(got hier_ici={exchanger.ici_leg!r})"
+                )
+        if inner._bucketed is None:
             raise ValueError(
                 "StreamingExchange needs the bucketed exchange — construct "
                 "the GradientExchanger with cfg.bucket_bytes set"
             )
-        self.exchanger = exchanger
-        self.bucketed = exchanger._bucketed
-        self.cfg = exchanger.cfg
-        self.axis_name = exchanger.axis_name
-        self.names = list(exchanger.names)
+        self.exchanger = inner
+        self.bucketed = inner._bucketed
+        self.cfg = inner.cfg
+        self.axis_name = inner.axis_name
+        self.names = list(inner.names)
         self._pos = {n: i for i, n in enumerate(self.names)}
 
     def value_and_grad_exchange(
@@ -116,8 +148,25 @@ class StreamingExchange:
         specs = bucketed.specs
         has_res = residuals is not None
         widx = jax.lax.axis_index(self.axis_name)
+        key_repair_bits = 0.0
         if key is None:
             key = jax.random.PRNGKey(cfg.seed)
+        elif self.hier is not None:
+            # the HierarchicalExchanger contract: every ICI replica of a
+            # DCN group runs the identical stochastic encode — broadcast
+            # replica 0's key over the ici axis, exactly as the barrier
+            # path does (parallel/hierarchical.py)
+            n_ici = jax.lax.psum(1, self.hier.ici_axis)
+            if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+                kdata = jax.random.key_data(key)
+                key_repair_bits += kdata.size * 32.0 * (n_ici - 1)
+                kdata = jax.lax.all_gather(kdata, self.hier.ici_axis)[0]
+                key = jax.random.wrap_key_data(
+                    kdata, impl=jax.random.key_impl(key)
+                )
+            else:
+                key_repair_bits += key.size * 32.0 * (n_ici - 1)
+                key = jax.lax.all_gather(key, self.hier.ici_axis)[0]
         worker_key = jax.random.fold_in(key, widx)
 
         # trace-time side channel: the hooks' backward rules populate these
@@ -187,7 +236,26 @@ class StreamingExchange:
             collect["fp_universe"] = fp_u
             collect["bucket_saturated"] = bucketed.saturation_vector(stats_per)
 
-        return (loss, aux), grads, agg_tree, new_res, combine(stats_per)
+        wire = combine(stats_per)
+        if self.hier is not None:
+            # the ICI leg's wire share, split by fabric exactly as the
+            # barrier path accounts it: the dense slice-mean psum's
+            # ring-adjusted bits (whole tree — bucketing does not change
+            # the total) plus the key-repair gather when a key was passed.
+            # payload_bytes()/index+value bits stay DCN-only by contract.
+            n_ici = jax.lax.psum(1, self.hier.ici_axis)
+            ici_bits = key_repair_bits
+            if n_ici > 1:
+                d = sum(
+                    int(np.prod(l.shape)) if l.shape else 1
+                    for l in leaves_like
+                )
+                ici_bits += 2.0 * (n_ici - 1) / n_ici * 32.0 * d
+            wire = dataclasses.replace(
+                wire,
+                ici_bits=wire.ici_bits + jnp.asarray(ici_bits, jnp.float32),
+            )
+        return (loss, aux), grads, agg_tree, new_res, wire
 
     def _make_hook(self, b: int, stash, *, need_own: bool):
         """The identity custom_vjp hook for bucket `b`. Forward passes the
@@ -199,6 +267,7 @@ class StreamingExchange:
         spec = bucketed.specs[b]
         cfg = self.cfg
         axis = self.axis_name
+        ici_axis = self.hier.ici_axis if self.hier is not None else None
 
         @jax.custom_vjp
         def hook(p_leaves, r_leaves, step, worker_key, token):
@@ -211,24 +280,57 @@ class StreamingExchange:
             r_leaves, step, worker_key = saved
             g_leaves, token = cts
             num_workers = jax.lax.psum(1, axis)
-            # per-leaf memory.compensate (identical expression per leaf)
-            if need_own:
-                comp = tuple(
-                    cfg.beta * r + cfg.gamma * g
-                    for r, g in zip(r_leaves, g_leaves)
+            pre_encode = None
+            if ici_axis is not None:
+                # hierarchical composition: the bucket's ICI slice-mean
+                # psum + per-leaf compensate run in the pre_encode slot —
+                # after the entry barrier, so the token chain pins the
+                # psum's dispatch order too. psum(concat) == concat(psum)
+                # elementwise, and beta*r + gamma*sm commutes with concat,
+                # so every number matches the barrier-scheduled
+                # HierarchicalExchanger.exchange bit for bit.
+                n_ici = jax.lax.psum(1, ici_axis)
+                r_dense = (
+                    bucketed.concat_bucket(dict(zip(spec.names, r_leaves)), spec)
+                    if need_own
+                    else None
                 )
+
+                def pre_encode(dense):
+                    with spans.span("exchange/ici"):
+                        sm = jax.lax.psum(dense, ici_axis) / n_ici
+                    if need_own:
+                        return cfg.beta * r_dense + cfg.gamma * sm
+                    return sm
+
+                flat = dict(zip(spec.names, g_leaves))
             else:
-                comp = tuple(g_leaves)
-            flat = dict(zip(spec.names, comp))
-            total, own, stats, payload, token = bucketed.run_streaming_bucket(
-                b,
-                flat,
-                num_workers,
-                step,
-                worker_key,
-                need_own=need_own,
-                token=token,
+                # per-leaf memory.compensate (identical expression per leaf)
+                if need_own:
+                    comp = tuple(
+                        cfg.beta * r + cfg.gamma * g
+                        for r, g in zip(r_leaves, g_leaves)
+                    )
+                else:
+                    comp = tuple(g_leaves)
+                flat = dict(zip(spec.names, comp))
+            total, own, stats, payload, token, dense = (
+                bucketed.run_streaming_bucket(
+                    b,
+                    flat,
+                    num_workers,
+                    step,
+                    worker_key,
+                    need_own=need_own,
+                    token=token,
+                    pre_encode=pre_encode,
+                )
             )
+            if ici_axis is not None:
+                # the hook's comp leaves are slices of the compensated
+                # slice-mean run_streaming_bucket encoded
+                comp_slices = bucketed.split_bucket(spec, dense)
+                comp = tuple(comp_slices[n] for n in spec.names)
             agg_slices = bucketed.split_bucket(spec, total / num_workers)
             agg_ct = tuple(
                 agg_slices[n].astype(c.dtype) for n, c in zip(spec.names, comp)

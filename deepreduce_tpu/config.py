@@ -70,11 +70,14 @@ REASON_CODES: Dict[str, str] = {
     "bucket-order-needs-buckets": "bucket_order set without bucket_bytes",
     "stream-needs-buckets": "stream_exchange without bucket_bytes",
     "stream-vs-resilience": "stream_exchange cannot thread resilience state",
-    "stream-vs-hier": "stream_exchange cannot split the two-leg hier schedule",
+    "stream-vs-hier":
+        "stream_exchange composes over the dense-ICI hier leg only "
+        "(allgather, loop/vmap decode, no ctrl/fed)",
     "stream-vs-fed": "stream_exchange hooks a path the fed round never runs",
     "resilience-knobs-disengaged": "resilience knob(s) without resilience=True",
     "resilience-vs-owner-communicator":
-        "participation mask cannot mask shard ownership (qar/sparse_rs)",
+        "participation mask cannot re-own this communicator's shards "
+        "(qar; sparse_rs adaptive/sketch routes)",
     "chaos-needs-checksum": "chaos injection without payload_checksum",
     "checksum-needs-fused-allgather":
         "payload_checksum outside the fused allgather wire format",
@@ -689,19 +692,36 @@ class DeepReduceConfig:
                 "barrier/pipeline schedules with it"
             )
         if self.stream_exchange and self.hier:
-            # Structurally impossible today: the hierarchical exchanger owns
-            # its own two-leg schedule (ICI psum then DCN exchange) built
-            # around the whole-pytree gradient; streaming would have to
-            # split BOTH legs per bucket and the ICI slice-mean psum per
-            # hook. A flat streaming exchange over a multi-axis mesh (tuple
-            # axis_name) works fine and is what the tests cover.
-            raise ConfigError(
-                "stream-vs-hier",
-                "stream_exchange=True streams the flat bucketed exchange "
-                "and cannot compose with hier=True's two-leg slice schedule "
-                "— use the flat exchange over the full mesh (a tuple "
-                "axis_name works), or hier without streaming"
+            # Streaming composes with the hierarchical schedule on exactly
+            # one shape of the plan space: the dense-ICI, config-pinned-DCN,
+            # bucketed-allgather leg stack. There the custom_vjp hooks run
+            # each bucket's ICI slice-mean psum AND its compressed DCN
+            # gather inside backprop, with optimization_barrier tokens
+            # pinning the per-axis collective order (comm_stream.py).
+            # Everything else keeps the loud fence: a qar ICI leg and an
+            # auto-rewritten DCN route restructure the legs per step, the
+            # ring decode addresses flat peers, and the ctrl/fed planes
+            # rebuild the exchanger the hooks captured.
+            composable_hier_stream = (
+                self.communicator == "allgather"
+                and self.hier_ici == "dense"
+                and self.hier_dcn == "config"
+                and self.decode_strategy in ("loop", "vmap")
+                and not self.ctrl
+                and not self.fed
+                and not self.fed_async
+                and self.fed_tenants == 0
             )
+            if not composable_hier_stream:
+                raise ConfigError(
+                    "stream-vs-hier",
+                    "stream_exchange=True over hier=True composes only as "
+                    "the dense-ICI + config-pinned bucketed-allgather DCN "
+                    "leg stack (communicator='allgather', hier_ici='dense', "
+                    "hier_dcn='config', decode_strategy in loop/vmap, no "
+                    "ctrl/fed planes) — this config restructures a leg the "
+                    "streaming hooks captured at trace time"
+                )
         if self.stream_exchange and self.fed:
             raise ConfigError(
                 "stream-vs-fed",
@@ -741,28 +761,45 @@ class DeepReduceConfig:
                 "resilience=True (or drop the knob(s))"
             )
         if self.resilience and self.communicator not in ("allgather", "allreduce"):
-            # Why the mask cannot thread through qar/sparse_rs: in those
-            # exchanges every worker is also *infrastructure* — the static
-            # all_to_all/psum_scatter routing makes each worker the owner of
-            # one universe shard. A participation mask can zero a worker's
-            # CONTRIBUTION (expressible), but a dropped worker's OWNERSHIP
-            # cannot be masked: the collective permutation is baked into the
-            # trace, so its whole shard of the aggregate would black-hole
-            # for every surviving worker. Graceful degradation of an owner
-            # requires re-sharding the universe over the live set — a shape
-            # change, hence a retrace, which the per-step mask contract
-            # (one static trace, mask as traced data) rules out. allgather/
-            # allreduce have no owners: a dead worker only removes its own
-            # contribution, which renormalization absorbs.
-            raise ConfigError(
-                "resilience-vs-owner-communicator",
-                "resilience=True threads a participation mask through the "
-                "exchange, which only the allgather/allreduce communicators "
-                f"support — communicator={self.communicator!r} makes every "
-                "worker a shard owner (static all_to_all/psum_scatter "
-                "routing), so a dropped worker would black-hole its shard "
-                "of the aggregate instead of degrading gracefully"
+            # Shard ownership used to fence resilience off EVERY sparse_rs
+            # route: the static all_to_all/psum_scatter routing makes each
+            # worker the owner of one universe shard, so a dropped worker's
+            # shard would black-hole for every survivor. The sparse /
+            # quantized / oktopk routes now re-own shards under the mask — a
+            # traced permutation of the live set (owner_of[s]) re-assigns a
+            # dropped owner's shard to a live deputy inside the SAME static
+            # trace, and the decode renormalizes by the live count like the
+            # allgather path (sparse_rs.py). That carve-out is exactly the
+            # flat loop-decoded sparse_rs exchange: the adaptive lane split
+            # and the sketch route still bake per-worker state into the
+            # wire layout (no deputy can reproduce a dead worker's lanes /
+            # sketch rows), and the bucketed / hier / streaming / fed
+            # shapes never thread the mask to the reduce-scatter leg.
+            reowned_sparse_rs = (
+                self.communicator == "sparse_rs"
+                and self.rs_mode in ("sparse", "quantized", "oktopk", "auto")
+                and not self.hier
+                and not self.stream_exchange
+                and self.decode_strategy == "loop"
+                and self.bucket_bytes is None
+                and not self.fed
+                and not self.fed_async
+                and self.fed_tenants == 0
             )
+            if not reowned_sparse_rs:
+                raise ConfigError(
+                    "resilience-vs-owner-communicator",
+                    "resilience=True threads a participation mask through "
+                    "the exchange, which the allgather/allreduce "
+                    "communicators and the flat loop-decoded sparse_rs "
+                    "routes (rs_mode sparse/quantized/oktopk/auto, no "
+                    "buckets/hier/stream/fed) support — communicator="
+                    f"{self.communicator!r} with this shape makes every "
+                    "worker a shard owner whose shard has no live-set "
+                    "re-ownership path, so a dropped worker would "
+                    "black-hole its shard of the aggregate instead of "
+                    "degrading gracefully"
+                )
         chaos_on = (
             self.chaos_drop_rate > 0
             or self.chaos_corrupt_rate > 0

@@ -684,10 +684,17 @@ def rs_wire_bytes(
     cols: int = 0,
     bins: int = 4096,
     cap_headroom: float = 2.0,
+    masked: bool = False,
 ) -> Dict[str, float]:
     """Per-collective injection bytes for one sparse_rs route. Keys are the
     collective primitive names the route traces; values are the operand
-    bytes one worker contributes to that collective."""
+    bytes one worker contributes to that collective.
+
+    `masked` prices the live-mask-aware re-ownership variants
+    (sparse_rs.owner_permutation): the sparse/oktopk wire layout is
+    unchanged (global indices ride the same lanes local ones did), but the
+    quantized route adds one int8 all_gather of the summed [Ssh] shard so
+    deputies can dequantize the shards they serve."""
     B = _send_budget(d, ratio, W, headroom)
     K2 = _out_budget(d, ratio, W, out_headroom)
     if mode == "sparse":
@@ -697,10 +704,11 @@ def rs_wire_bytes(
         return {"all_to_all": W * B * 8.0, "all_gather": (L + 1) * 4.0}
     if mode == "quantized":
         n = quantized_padded_len(d, W, block)
+        extra = (n // W) * 1.0 if masked else 0.0
         return {
             "pmax": (n // block) * 4.0,
             "psum_scatter": n * 1.0,
-            "all_gather": K2 * 8.0,
+            "all_gather": K2 * 8.0 + extra,
         }
     if mode == "sketch":
         C = sketch_cols(d, ratio, rows, cols)
@@ -800,7 +808,7 @@ def rs_step_time(
 def _rs_kw(kw: Dict) -> Dict:
     """Filter **kw down to the keys rs_wire_bytes understands."""
     keep = ("headroom", "out_headroom", "block", "rows", "cols",
-            "bins", "cap_headroom")
+            "bins", "cap_headroom", "masked")
     return {k: kw[k] for k in keep if k in kw}
 
 
@@ -988,6 +996,64 @@ def hier_step_time(
     )
 
 
+def stream_hier_step_time(
+    dcn: str,
+    d: int,
+    n_slices: int,
+    per_slice: int,
+    ratio: float,
+    *,
+    bw_ici: Optional[float] = None,
+    bw_dcn: Optional[float] = None,
+    ici_block: int = 512,
+    measurement: Optional[Dict[str, float]] = None,
+    compute_time: float = 0.0,
+    profile: Optional[MachineProfile] = None,
+) -> float:
+    """`overlapped_step_time` composed with the hierarchical two-leg model:
+    the stream-over-hier schedule dispatches each bucket's dense ICI psum
+    AND its compressed DCN gather from inside the bucket's backward hook,
+    so hideable backward compute shaves the COMBINED wire of both legs —
+    the barrier-scheduled `hier_step_time` can only hide the DCN leg.
+
+    Defined for the composable stack only: dense ICI + the allgather
+    family on DCN (`dcn` in {"fused", "bucketed"} — the config fences
+    every other shape out of streaming). With ``compute_time=0`` the
+    "fused" form is exactly ``hier_step_time("dense", "fused", ...)``
+    (nothing to hide behind), and "bucketed" pays
+    ``max(ici + dcn_wire, decode)`` ≤ the barrier schedule's
+    ``ici + max(dcn_wire, decode)`` — so the composed model can never
+    exceed the barrier-hier parent, and with compute it can never exceed
+    what the same compute buys the flat streaming parent on a scarcer
+    gather."""
+    if dcn not in ("fused", "bucketed"):
+        raise ValueError(
+            f"stream_hier_step_time composes the allgather family only "
+            f"(fused/bucketed), got dcn={dcn!r}"
+        )
+    rm = route_measurement(profile, dcn)
+    if measurement is not None:
+        m = measurement
+    elif rm is not None:
+        m = {"payload_bytes": 8.0 * max(1, int(d * ratio)), **rm}
+    else:
+        m = {
+            "payload_bytes": 8.0 * max(1, int(d * ratio)),
+            "t_encode_s": profile.t_enc_s if profile is not None else 0.0,
+            "t_decode_s": profile.t_dec_s if profile is not None else 0.0,
+        }
+    ici_wire = hier_ici_time(
+        "dense", d, per_slice, bw_ici, block=ici_block, profile=profile
+    )
+    dcn_wire = allgather_time(
+        m["payload_bytes"], n_slices, _bw_dcn(bw_dcn, profile)
+    )
+    exposed = max(0.0, ici_wire + dcn_wire - max(0.0, compute_time))
+    if dcn == "bucketed":
+        return m["t_encode_s"] + max(exposed, n_slices * m["t_decode_s"])
+    return m["t_encode_s"] + exposed + n_slices * m["t_decode_s"]
+
+
 def select_hier_plan(
     d: int,
     n_slices: int,
@@ -1002,6 +1068,7 @@ def select_hier_plan(
     measurements: Optional[Dict[str, Dict[str, float]]] = None,
     compute: Optional[Dict[str, float]] = None,
     compute_time: float = 0.0,
+    stream: bool = False,
     profile: Optional[MachineProfile] = None,
     **kw,
 ) -> Dict:
@@ -1018,6 +1085,15 @@ def select_hier_plan(
     `measurements` rows still win) — this is the selector a fitted profile
     can actually flip.
 
+    ``stream=True`` makes the planner overlap-aware: the composable
+    candidates (dense ICI x fused/bucketed DCN — the only stack the
+    config lets streaming wrap) are priced with `stream_hier_step_time`,
+    where ``compute_time`` hides the combined ici+dcn wire instead of the
+    dcn leg alone; every other candidate keeps the barrier model so the
+    argmin compares what streaming actually buys. The default False keeps
+    the historical table to the last float (the calib-reselect audit pins
+    it).
+
     Returns {"ici", "dcn", "modeled_step_s", "table"} where table maps
     "ici+dcn" -> modeled seconds for every candidate pair."""
     ici_cands = ici_legs or HIER_ICI_LEGS
@@ -1028,12 +1104,20 @@ def select_hier_plan(
         m = (measurements or {}).get(dcn)
         tc = (compute or {}).get(dcn, 0.0)
         for ici in ici_cands:
-            t = hier_step_time(
-                ici, dcn, d, n_slices, per_slice, ratio,
-                bw_ici=bw_ici, bw_dcn=bw_dcn, ici_block=ici_block,
-                measurement=m, t_compute_s=tc, compute_time=compute_time,
-                profile=profile, **kw,
-            )
+            if stream and ici == "dense" and dcn in ("fused", "bucketed"):
+                t = stream_hier_step_time(
+                    dcn, d, n_slices, per_slice, ratio,
+                    bw_ici=bw_ici, bw_dcn=bw_dcn, ici_block=ici_block,
+                    measurement=m, compute_time=compute_time,
+                    profile=profile,
+                )
+            else:
+                t = hier_step_time(
+                    ici, dcn, d, n_slices, per_slice, ratio,
+                    bw_ici=bw_ici, bw_dcn=bw_dcn, ici_block=ici_block,
+                    measurement=m, t_compute_s=tc, compute_time=compute_time,
+                    profile=profile, **kw,
+                )
             table[f"{ici}+{dcn}"] = t
             if best is None or t < table[f"{best[0]}+{best[1]}"]:
                 best = (ici, dcn)

@@ -1,0 +1,207 @@
+"""Composable exchange legs: one protocol over every gradient-exchange stack.
+
+Every exchange path in this repo — flat fused allgather, bucketed, ring
+decode, the in-collective sparse_rs routes, qar, the two-tier hierarchical
+exchange, and the backprop-streamed bucket schedule — shares one shape:
+
+    encode -> collective plan (over named mesh axes) -> decode -> stats
+
+This module names that shape.  `Exchanger` is the structural protocol the
+stacks implement (`GradientExchanger`, `HierarchicalExchanger`); `Leg`
+describes one stage of a stack's collective plan — which role it plays and
+which named mesh axis its collectives ride; `leg_plan` derives the plan of
+any built stack by inspection; `build_exchanger` is the one factory that
+composes a stack from a config (flat / hier, ctrl-rung substitution aside);
+`wrap_streaming` adds the backprop-overlap scheduling leg on top.
+
+Composition facts the plans make visible (enforced by config validation
+and the MATRIX audits, not by this module):
+
+- Stacking is by *wrapping*: `HierarchicalExchanger` wraps a flat
+  exchanger whose `axis_name` is the dcn axis, and prepends a dense psum
+  leg on ici; `StreamingExchange` wraps either and re-schedules the wrapped
+  stack's per-bucket legs into custom_vjp backward hooks (the ici leg rides
+  INSIDE each bucket's optimization-barrier bracket).
+- A leg's wire accounting is axis-local: `payload_bytes()` is the dcn-leg
+  (or flat-axis) injection only; ici traffic is reported separately via
+  `WireStats.ici_bits` (the jx-wire-accounting rule pins both).
+- Resilience is a decode-side leg property: the allgather path scales
+  gathered rows, the sparse_rs routes re-own shards over the live set
+  (`sparse_rs.owner_permutation`); both renormalize by the live count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Protocol, Tuple, runtime_checkable
+
+from deepreduce_tpu.config import DeepReduceConfig
+
+
+@runtime_checkable
+class Exchanger(Protocol):
+    """The structural protocol every exchange stack implements.
+
+    `axis_name` is the named mesh axis (or axis tuple) the stack's
+    collectives ride; `exchange` runs one encode -> collective -> decode
+    round inside shard_map and returns (aggregated grads, new residual
+    state, WireStats); `payload_bytes` is the static per-worker injection
+    on the stack's wire-accounted axis."""
+
+    cfg: DeepReduceConfig
+
+    @property
+    def axis_name(self): ...
+
+    def init_state(self, params: Any) -> Any: ...
+
+    def exchange(self, grads: Any, state: Any, *, step, key=None,
+                 collect=None, mask=None) -> Tuple[Any, Any, Any]: ...
+
+    def payload_bytes(self, grads_like: Any) -> int: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class Leg:
+    """One stage of an exchange stack's collective plan.
+
+    role: 'encode' | 'collective' | 'decode' | 'stats' | 'schedule'
+    axis: the named mesh axis the leg's collectives ride (None for
+          host/compute-only legs)
+    kind: the concrete mechanism, e.g. 'dense-psum', 'fused-allgather',
+          'bucketed-allgather', 'ring-permute', 'sparse_rs:oktopk',
+          'qar', 'stream-hooks', 'masked-reowner'
+    """
+
+    role: str
+    axis: Optional[str]
+    kind: str
+
+    def __str__(self) -> str:
+        ax = self.axis or "-"
+        return f"{self.role}@{ax}:{self.kind}"
+
+
+def _flat_legs(ex, axis) -> Tuple[Leg, ...]:
+    """Collective plan of a flat GradientExchanger on `axis`."""
+    cfg = ex.cfg
+    if cfg.communicator == "qar":
+        return (
+            Leg("encode", None, "int8-bucket-quantize"),
+            Leg("collective", axis, "qar"),
+            Leg("decode", None, "dequantize"),
+            Leg("stats", None, "wire"),
+        )
+    if cfg.communicator == "sparse_rs":
+        kind = f"sparse_rs:{ex._rs_mode}"
+        legs = [Leg("encode", None, "topk-route")]
+        if cfg.resilience:
+            legs.append(Leg("decode", axis, "masked-reowner"))
+        legs += [
+            Leg("collective", axis, kind),
+            Leg("decode", None, "shard-reselect"),
+            Leg("stats", None, "wire"),
+        ]
+        return tuple(legs)
+    if cfg.communicator == "allreduce" or (
+        cfg.deepreduce is None and cfg.compressor == "none"
+    ):
+        return (
+            Leg("collective", axis, "dense-psum"),
+            Leg("stats", None, "wire"),
+        )
+    # fused / bucketed allgather family
+    gather = (
+        "bucketed-allgather" if ex._bucketed is not None else "fused-allgather"
+    )
+    decode = {
+        "loop": "per-worker-loop",
+        "vmap": "batched-vmap",
+        "ring": "ring-permute",
+    }[cfg.decode_strategy]
+    legs = [Leg("encode", None, "codec-pack")]
+    if cfg.decode_strategy == "ring":
+        legs.append(Leg("collective", axis, "ring-permute"))
+    else:
+        legs.append(Leg("collective", axis, gather))
+    if cfg.resilience:
+        legs.append(Leg("decode", None, "masked-row-weights"))
+    legs += [Leg("decode", None, decode), Leg("stats", None, "wire")]
+    return tuple(legs)
+
+
+def leg_plan(ex) -> Tuple[Leg, ...]:
+    """Derive the collective plan of any built exchange stack by
+    inspection (duck-typed, like StreamingExchange's hier detection —
+    no import cycles, works on wrapped stacks)."""
+    # streaming wrapper: re-schedules the wrapped plan into bwd hooks
+    if hasattr(ex, "value_and_grad_exchange"):
+        inner = ex.hier if getattr(ex, "hier", None) is not None else ex.exchanger
+        return (Leg("schedule", None, "stream-hooks"),) + leg_plan(inner)
+    # hierarchical wrapper: ici leg + the inner dcn-leg plan
+    if hasattr(ex, "ici_axis") and hasattr(ex, "exchanger"):
+        ici = (
+            Leg("collective", ex.ici_axis, "dense-psum")
+            if ex.ici_leg == "dense"
+            else Leg("collective", ex.ici_axis, "qar")
+        )
+        return (ici,) + _flat_legs(ex.exchanger, ex.dcn_axis)
+    axis = ex.axis_name
+    return _flat_legs(ex, axis)
+
+
+def describe(ex) -> str:
+    """One-line plan description, e.g.
+    'stream-hooks | collective@ici:dense-psum | ...'."""
+    return " | ".join(str(l) for l in leg_plan(ex))
+
+
+def build_exchanger(
+    grads_like: Any,
+    cfg: DeepReduceConfig,
+    *,
+    axis_name: str = "data",
+    num_workers: Optional[int] = None,
+    num_slices: Optional[int] = None,
+    per_slice: Optional[int] = None,
+    profile=None,
+    bucket_points=None,
+):
+    """The one factory from config to composed exchange stack.
+
+    cfg.hier composes the hierarchical wrapper over the (dcn, ici) axes
+    (`num_slices`/`per_slice` give the static two-axis geometry);
+    otherwise a flat GradientExchanger on `axis_name`/`num_workers`.
+    Streaming is a scheduling property of the step, not of the stack —
+    wrap the result with `wrap_streaming` (train.make_worker_step does)."""
+    if cfg.hier:
+        from deepreduce_tpu.parallel.hierarchical import HierarchicalExchanger
+
+        if num_slices is None or per_slice is None:
+            raise ValueError(
+                "hier exchange needs the static two-axis geometry: "
+                "build_exchanger(..., num_slices=..., per_slice=...)"
+            )
+        return HierarchicalExchanger(
+            grads_like, cfg, num_slices=num_slices, per_slice=per_slice,
+            profile=profile,
+        )
+    from deepreduce_tpu.comm import GradientExchanger
+
+    return GradientExchanger(
+        grads_like, cfg, axis_name=axis_name, num_workers=num_workers,
+        profile=profile, bucket_points=bucket_points,
+    )
+
+
+def wrap_streaming(exchanger):
+    """The backprop-overlap scheduling leg: returns a StreamingExchange
+    over the stack when cfg.stream_exchange is set, else None. Works over
+    flat AND hierarchical stacks (the composed stream-over-hier path runs
+    each bucket's ici psum + compressed dcn gather inside the bucket's
+    backward hook)."""
+    if not exchanger.cfg.stream_exchange:
+        return None
+    from deepreduce_tpu.comm_stream import StreamingExchange
+
+    return StreamingExchange(exchanger)

@@ -173,6 +173,7 @@ def exchange(
     oktopk_cap_headroom: float = 2.0,
     key: Optional[jax.Array] = None,
     collect: Optional[dict] = None,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, WireStats]:
     """-> (mean gradient f32[d], own-transmitted dense f32[d] for error
     feedback, wire stats). Call inside shard_map over `axis_name`.
@@ -181,7 +182,43 @@ def exchange(
     caller). `key` is required by the stochastic-rounding routes (adaptive,
     quantized). `collect`, when a dict, receives the adaptive route's
     density/switch observables and the oktopk route's survivor/threshold/
-    spill observables."""
+    spill observables.
+
+    `mask` (replicated bool/f32[W], the resilience participation mask)
+    selects the live-mask-aware variants of the sparse / quantized /
+    oktopk routes: shard ownership is re-assigned over the live set by a
+    traced permutation (`owner_permutation`), dropped contributions are
+    zeroed on both the send and receive side, and the mean renormalizes
+    by the live count — one static trace, mask as traced data, with the
+    all-ones mask bitwise-equal to the mask-free route on the exchanged
+    outputs. The adaptive and sketch routes bake per-worker lane/sketch
+    state into the wire layout that no deputy can reproduce; they refuse
+    the mask (config fences them as resilience-vs-owner-communicator)."""
+    if mask is not None:
+        if rs_mode == "sparse":
+            return _exchange_sparse_masked(
+                flat, axis_name, num_workers, ratio=ratio,
+                approx_topk=approx_topk, headroom=headroom,
+                out_headroom=out_headroom, mask=mask,
+            )
+        if rs_mode == "quantized":
+            return _exchange_quantized_masked(
+                flat, axis_name, num_workers, ratio=ratio,
+                out_headroom=out_headroom, block=block_size, key=key,
+                mask=mask,
+            )
+        if rs_mode == "oktopk":
+            return _exchange_oktopk_masked(
+                flat, axis_name, num_workers, ratio=ratio,
+                out_headroom=out_headroom, bins=oktopk_bins,
+                cap_headroom=oktopk_cap_headroom, collect=collect, mask=mask,
+            )
+        raise ValueError(
+            f"rs_mode={rs_mode!r} has no live-mask-aware variant (adaptive "
+            "lane switches and sketch rows are per-worker wire state no "
+            "deputy can re-own) — config fences this as "
+            "resilience-vs-owner-communicator"
+        )
     if rs_mode == "sparse":
         return _exchange_sparse(
             flat, axis_name, num_workers, ratio=ratio, approx_topk=approx_topk,
@@ -760,6 +797,339 @@ def _exchange_oktopk(
 
     # wire accounting: histogram lanes are value-side; every routed or
     # gathered entry is an f32 value + i32 index
+    stats = WireStats(
+        index_bits=jnp.asarray((W * Bo + K2) * 32.0, jnp.float32),
+        value_bits=jnp.asarray((W * Bo + K2 + bins) * 32.0, jnp.float32),
+        dense_bits=jnp.asarray(d * 32.0, jnp.float32),
+    )
+    return mean.astype(flat.dtype), own_dense, stats
+
+
+# --------------------------------------------------------------------------- #
+# Live-mask-aware routes: shard re-ownership over the live set                #
+# --------------------------------------------------------------------------- #
+#
+# The reduce-scatter routes assign shard s to worker s.  Under the resilience
+# participation mask a dropped owner would silently eat its shard: nobody
+# reduces it, nobody re-selects it, and the mean loses a 1/W slice of every
+# step.  The masked variants below re-own shards by a TRACED permutation of
+# the live set (mask is data, the trace is static — same contract as the
+# allgather resilience path): senders route each entry to owner_of[shard]
+# carrying GLOBAL indices (a deputy owns foreign shards, so shard-local
+# offsets are ambiguous), receivers scatter-add into a [W*S] deputy buffer
+# with rows zeroed by the mask, phase 2 re-selects only owned coordinates,
+# and the mean renormalizes by the live count exactly like the allgather
+# row-weights path.  A dropped worker's own-transmitted estimate is zero, so
+# its entire update stays in its residual (error feedback conserves the
+# mass).  Under the all-ones mask every step is a *1.0 / +0.0 / identity-
+# permutation no-op, so the exchanged outputs are bitwise-equal to the
+# mask-free route (tie-breaking caveat: with fewer than K2 nonzero owned
+# magnitudes the zero-value padding picks park at different — still
+# zero-valued — coordinates).
+
+
+def owner_permutation(mask, num_workers: int) -> jax.Array:
+    """Traced shard re-ownership map: owner_of[s] = worker serving shard s
+    under the participation mask. Live workers keep their own shards; a
+    dropped worker's shard is deputized to the live worker at rank
+    (s mod n_live) of the ascending live set. Identity under the all-ones
+    mask, deterministic, and replicated — every worker derives the same
+    permutation from the same replicated mask."""
+    W = num_workers
+    mask_f = jnp.asarray(mask, jnp.float32).reshape((W,))
+    live = mask_f > 0.0
+    n_live = jnp.sum(live.astype(jnp.int32))
+    # live worker ids packed to the front, ascending (stable argsort of
+    # the not-live flags)
+    packed = jnp.argsort(jnp.logical_not(live), stable=True).astype(jnp.int32)
+    deputy = packed[
+        jnp.mod(jnp.arange(W, dtype=jnp.int32), jnp.maximum(n_live, 1))
+    ]
+    return jnp.where(live, jnp.arange(W, dtype=jnp.int32), deputy)
+
+
+def _masked_route(
+    values, indices, select, owner_of, live_self, W, S, B, axis_name,
+    mask_f, dtype, route,
+):
+    """Masked phase 1: route candidate entries (select mask applied) to the
+    DEPUTY owner of their shard through one all_to_all, carrying global
+    indices; scatter-add into the [W*S] deputy buffer with sender rows
+    zeroed by the mask. Returns (deputy_buf f32[W*S], keep, idxs, vals,
+    pos) — the latter four feed the own-transmitted EF scatter."""
+    k = values.shape[0]
+    # target worker = deputy owner of the entry's shard; dead -> parked W
+    tw = jnp.where(
+        select, owner_of[jnp.clip(indices // S, 0, W - 1)], W
+    )
+    # stable sort by target keeps lax.top_k's descending-|v| order within
+    # each target's run, so budget overflow drops the smallest magnitudes
+    order = jnp.argsort(tw, stable=True)
+    tws = tw[order]
+    vals = values[order]
+    idxs = indices[order]
+    pos = jnp.arange(k, dtype=jnp.int32)
+    first_of_run = jnp.where(
+        jnp.concatenate([jnp.ones((1,), bool), tws[1:] != tws[:-1]]), pos, -1
+    )
+    run_start = jax.lax.cummax(first_of_run)
+    rank = pos - run_start
+    # a dropped sender transmits nothing: its mass stays in the residual
+    keep = jnp.logical_and(
+        jnp.logical_and(tws < W, rank < B), live_self
+    )
+    tgt = jnp.where(keep, tws * B + rank, W * B + pos)
+    send_v = (
+        jnp.zeros((W * B,), dtype)
+        .at[tgt].set(vals, mode="drop", unique_indices=True)
+        .reshape(W, B)
+    )
+    # GLOBAL index on the wire — the deputy owns foreign shards, so a
+    # shard-local offset would be ambiguous; dead slots point at 0 with
+    # value 0
+    send_i = (
+        jnp.zeros((W * B,), jnp.int32)
+        .at[tgt].set(idxs, mode="drop", unique_indices=True)
+        .reshape(W, B)
+    )
+    send_buf = jnp.concatenate(
+        [send_v.astype(jnp.float32),
+         jax.lax.bitcast_convert_type(send_i, jnp.float32)], axis=1
+    )  # [W, 2B]
+    with spans.span("sparse_rs/route", route=route):
+        rx = jax.lax.all_to_all(
+            send_buf, axis_name, split_axis=0, concat_axis=0, tiled=True
+        )
+    # receiver-side row zeroing mirrors the allgather row-weights path
+    # (belt and braces with the sender-side keep gate; *1.0 is exact)
+    rx_v = rx[:, :B] * mask_f[:, None]
+    rx_i = jax.lax.bitcast_convert_type(rx[:, B:], jnp.int32)
+    with spans.span("sparse_rs/reduce", route=route):
+        deputy_buf = (
+            jnp.zeros((W * S,), jnp.float32)
+            .at[jnp.clip(rx_i.reshape(-1), 0, W * S - 1)]
+            .add(rx_v.reshape(-1).astype(jnp.float32))
+        )
+    return deputy_buf, keep, idxs, vals, pos
+
+
+def _masked_phase2(est, owned, W, S, K2, axis_name, mask_f, route):
+    """Masked phase 2: re-select the K2 largest OWNED coordinates of the
+    [W*S] deputy estimate (indices are already global), allgather, and
+    scatter-add the mean numerator with gathered rows zeroed by the mask.
+    Returns (clipped global indices i32[W*K2], dense numerator f32[W*S])."""
+    mag = jnp.where(owned, jnp.abs(est), 0.0)
+    top_v, top_i = jax.lax.top_k(mag, K2)
+    # gate non-owned tie picks to exact zero (deputy_buf is zero outside
+    # the owned region by construction, but the gate keeps that invariant
+    # explicit)
+    out_vals = jnp.where(owned[top_i], est[top_i], 0.0)
+    out_idx = top_i.astype(jnp.int32)
+    out_buf = jnp.concatenate(
+        [out_vals.astype(jnp.float32),
+         jax.lax.bitcast_convert_type(out_idx, jnp.float32)]
+    )  # [2*K2]
+    with spans.span("sparse_rs/allgather", route=route):
+        gathered = jax.lax.all_gather(out_buf, axis_name)  # [W, 2*K2]
+    gathered_v = gathered[:, :K2] * mask_f[:, None]
+    gathered_i = jax.lax.bitcast_convert_type(gathered[:, K2:], jnp.int32)
+    gi = jnp.clip(gathered_i.reshape(-1), 0, W * S - 1)
+    dense = jnp.zeros((W * S,), jnp.float32).at[gi].add(
+        gathered_v.reshape(-1)
+    )
+    return gi, dense
+
+
+def _exchange_sparse_masked(
+    flat, axis_name, num_workers, *, ratio, approx_topk, headroom,
+    out_headroom, mask,
+):
+    """The sparse route under the live mask: re-owned routing, owned-only
+    phase-2 re-select, live-count renormalization. All-ones mask is
+    bitwise-equal to `_exchange_sparse` on the exchanged outputs."""
+    d = flat.shape[0]
+    W = num_workers
+    S = shard_size(d, W)
+    B = send_budget(d, ratio, W, headroom)
+    K2 = out_budget(d, ratio, W, out_headroom)
+    mask_f = jnp.asarray(mask, jnp.float32).reshape((W,))
+    widx = jax.lax.axis_index(axis_name)
+    live_self = mask_f[widx] > 0.0
+    owner_of = owner_permutation(mask_f, W)
+
+    with spans.span("sparse_rs/select", route="sparse"):
+        sp = sparse.topk(flat, ratio, sort_indices=False, approx=approx_topk)
+    k = sp.k
+    live = jnp.arange(k, dtype=jnp.int32) < sp.nnz
+
+    deputy_buf, keep, idxs, vals, pos = _masked_route(
+        sp.values, sp.indices, live, owner_of, live_self, W, S, B,
+        axis_name, mask_f, flat.dtype, "sparse",
+    )
+    owned = owner_of[jnp.arange(W * S, dtype=jnp.int32) // S] == widx
+    gi, dense = _masked_phase2(
+        deputy_buf, owned, W, S, K2, axis_name, mask_f, "sparse"
+    )
+    # renormalize by the live count, exactly like the allgather row-weights
+    # path (sum of W ones is exactly W.0 in f32 -> /W bitwise under all-ones)
+    denom = jnp.maximum(jnp.sum(mask_f), 1.0)
+    mean = dense[:d] / denom
+
+    own_dense = _own_transmitted(flat, keep, idxs, vals, pos, W, S, d)
+
+    stats = WireStats(
+        index_bits=jnp.asarray((W * B + K2) * 32.0, jnp.float32),
+        value_bits=jnp.asarray((W * B + K2) * 32.0, jnp.float32),
+        dense_bits=jnp.asarray(d * 32.0, jnp.float32),
+    )
+    return mean.astype(flat.dtype), own_dense, stats
+
+
+def _exchange_quantized_masked(
+    flat, axis_name, num_workers, *, ratio, out_headroom, block, key, mask,
+):
+    """The quantized route under the live mask. The psum_scatter still
+    lands shard s on worker s — re-ownership instead adds ONE int8
+    all_gather of the summed shard (+Ssh wire bytes, mirrored by
+    `costmodel.rs_wire_bytes(masked=True)`) so every deputy can dequantize
+    the shards it serves. Dropped workers are excluded on both legs: their
+    norms leave the pmax and their levels leave the integer sum, so the
+    live-sum bound n_live*q <= W*q <= 127 still holds."""
+    if key is None:
+        raise ValueError("rs_mode='quantized' needs a PRNG key (stochastic "
+                         "rounding of the int8 levels)")
+    d = flat.shape[0]
+    W = num_workers
+    n = padded_shard(d, W, block) * W
+    Ssh = n // W
+    K2 = out_budget(d, ratio, W, out_headroom)
+    q = quantized_levels_budget(W)
+    widx = jax.lax.axis_index(axis_name)
+    mask_f = jnp.asarray(mask, jnp.float32).reshape((W,))
+    live_self = mask_f[widx] > 0.0
+    owner_of = owner_permutation(mask_f, W)
+
+    gp = jnp.zeros((n,), jnp.float32).at[:d].set(flat)
+    norms_local = jnp.linalg.norm(gp.reshape(-1, block), axis=1)
+    # a dropped worker's scale must not inflate the shared max
+    norms_eff = jnp.where(live_self, norms_local, 0.0)
+    with spans.span("sparse_rs/norm-pmax", route="quantized"):
+        norms_shared = jax.lax.pmax(norms_eff, axis_name)
+    with spans.span("sparse_rs/quantize", route="quantized"):
+        levels, _ = qar.bucket_quantize(
+            gp, q, block, jax.random.fold_in(key, widx), norms=norms_shared
+        )
+    # zero the dropped worker's integer contribution before the exact sum
+    levels_eff = jnp.where(live_self, levels, jnp.zeros_like(levels))
+    with spans.span("sparse_rs/reduce-scatter", route="quantized"):
+        summed = jax.lax.psum_scatter(
+            levels_eff, axis_name, scatter_dimension=0, tiled=True
+        )  # int8[Ssh]
+    # the one extra wire leg: every worker sees every summed shard, so a
+    # deputy can serve a dropped owner's shard in phase 2
+    with spans.span("sparse_rs/shard-allgather", route="quantized"):
+        all_shards = jax.lax.all_gather(summed, axis_name)  # int8[W, Ssh]
+
+    bpw = Ssh // block  # norm blocks per shard
+    est = jnp.zeros((n,), jnp.float32)
+    for v in range(W):  # static: one dequantize per shard, owner-gated
+        norms_v = jax.lax.dynamic_slice(norms_shared, (v * bpw,), (bpw,))
+        deq_v = qar.bucket_dequantize(all_shards[v], norms_v, q, block)
+        est = jax.lax.dynamic_update_slice(
+            est, jnp.where(owner_of[v] == widx, deq_v, 0.0), (v * Ssh,)
+        )
+
+    owned = owner_of[jnp.arange(n, dtype=jnp.int32) // Ssh] == widx
+    gi, dense = _masked_phase2(
+        est, owned, W, Ssh, K2, axis_name, mask_f, "quantized"
+    )
+    denom = jnp.maximum(jnp.sum(mask_f), 1.0)
+    mean = dense[:d] / denom
+
+    # own contribution from the ZEROED levels: a dropped worker contributed
+    # nothing, so its full update stays in the residual
+    my_deq = qar.bucket_dequantize(levels_eff, norms_shared, q, block)
+    own_dense = jnp.zeros((n,), jnp.float32).at[gi].add(my_deq[gi])[:d]
+
+    stats = WireStats(
+        index_bits=jnp.asarray(K2 * 32.0, jnp.float32),
+        value_bits=jnp.asarray(
+            n * 8.0 + (n // block) * 32.0 + K2 * 32.0 + Ssh * 8.0,
+            jnp.float32,
+        ),
+        dense_bits=jnp.asarray(d * 32.0, jnp.float32),
+    )
+    return mean.astype(flat.dtype), own_dense.astype(flat.dtype), stats
+
+
+def _exchange_oktopk_masked(
+    flat, axis_name, num_workers, *, ratio, out_headroom, bins,
+    cap_headroom, collect, mask,
+):
+    """The Ok-Topk route under the live mask: the dropped worker's
+    candidates leave the psum'd histogram (the global threshold is chosen
+    over live candidates only), survivors route to deputy owners, phase 2
+    re-selects owned coordinates, the mean renormalizes by the live
+    count. Wire layout is unchanged from the mask-free route."""
+    d = flat.shape[0]
+    W = num_workers
+    S = shard_size(d, W)
+    Bo = oktopk_send_budget(d, ratio, W, cap_headroom)
+    K2 = out_budget(d, ratio, W, out_headroom)
+    shift = oktopk_shift(bins)
+    widx = jax.lax.axis_index(axis_name)
+    mask_f = jnp.asarray(mask, jnp.float32).reshape((W,))
+    live_self = mask_f[widx] > 0.0
+    owner_of = owner_permutation(mask_f, W)
+
+    with spans.span("exchange/encode", route="oktopk"):
+        with spans.span("sparse_rs/select", route="oktopk"):
+            sp = sparse.topk(flat, ratio, sort_indices=False, approx=False)
+        k = sp.k
+        live = jnp.arange(k, dtype=jnp.int32) < sp.nnz
+        mag = jnp.where(live, jnp.abs(sp.values), 0.0).astype(jnp.float32)
+
+        bucket = jnp.right_shift(
+            jax.lax.bitcast_convert_type(mag, jnp.int32), shift
+        )
+        weight = jnp.logical_and(live, mag > 0.0).astype(jnp.float32)
+        # a dropped worker's candidates must not move the global threshold
+        weight = jnp.where(live_self, weight, 0.0)
+        hist = jnp.zeros((bins,), jnp.float32).at[bucket].add(weight)
+        with spans.span("sparse_rs/psum", route="oktopk"):
+            g_hist = jax.lax.psum(hist, axis_name)
+        cum = jnp.flip(jnp.cumsum(jnp.flip(g_hist)))
+        ok = cum >= float(k)
+        b_star = jnp.max(
+            jnp.where(ok, jnp.arange(bins, dtype=jnp.int32), 0)
+        )
+        survive = jnp.logical_and(
+            jnp.logical_and(live, mag > 0.0), bucket >= b_star
+        )
+
+    deputy_buf, keep, idxs, vals, pos = _masked_route(
+        sp.values, sp.indices, survive, owner_of, live_self, W, S, Bo,
+        axis_name, mask_f, flat.dtype, "oktopk",
+    )
+    with spans.span("exchange/decode", route="oktopk"):
+        owned = owner_of[jnp.arange(W * S, dtype=jnp.int32) // S] == widx
+        gi, dense = _masked_phase2(
+            deputy_buf, owned, W, S, K2, axis_name, mask_f, "oktopk"
+        )
+        denom = jnp.maximum(jnp.sum(mask_f), 1.0)
+        mean = dense[:d] / denom
+
+        own_dense = _own_transmitted(flat, keep, idxs, vals, pos, W, S, d)
+
+    if collect is not None:
+        collect["rs_oktopk_survivors"] = jnp.take(cum, b_star)
+        collect["rs_oktopk_threshold"] = jax.lax.bitcast_convert_type(
+            jnp.left_shift(b_star, shift), jnp.float32
+        )
+        collect["rs_oktopk_spills"] = jnp.sum(
+            survive.astype(jnp.float32)
+        ) - jnp.sum(keep.astype(jnp.float32))
+
     stats = WireStats(
         index_bits=jnp.asarray((W * Bo + K2) * 32.0, jnp.float32),
         value_bits=jnp.asarray((W * Bo + K2 + bins) * 32.0, jnp.float32),

@@ -94,12 +94,13 @@ def make_worker_step(
     # Python-level gate like `resilient`: the streaming-off step traces the
     # identical source path as before, so its jaxpr stays byte-identical.
     # config.__post_init__ guarantees stream_exchange never combines with
-    # resilience, so the mask branch below is dead under streaming.
-    streaming = None
-    if cfg.stream_exchange:
-        from deepreduce_tpu.comm_stream import StreamingExchange
+    # resilience, so the mask branch below is dead under streaming. The
+    # scheduling leg composes over flat AND hierarchical stacks
+    # (exchange.wrap_streaming — the stream-over-hier path runs each
+    # bucket's ici psum inside its backward hook).
+    from deepreduce_tpu.exchange import wrap_streaming
 
-        streaming = StreamingExchange(exchanger)
+    streaming = wrap_streaming(exchanger)
 
     def step_fn(state: TrainState, batch, key: jax.Array, acc=None):
         collect = {} if telemetry else None
@@ -382,23 +383,23 @@ class Trainer:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         self._params_like = params
-        if self.cfg.hier:
-            from deepreduce_tpu.parallel.hierarchical import HierarchicalExchanger
-
-            self.exchanger = HierarchicalExchanger(
-                params, self.cfg,
-                num_slices=self.mesh.shape["dcn"],
-                per_slice=self.mesh.shape["ici"],
-            )
-        elif self._ctrl is not None:
+        if self._ctrl is not None:
             # start at the rung nearest cfg.compress_ratio; residual and
             # opt-state shapes are rung-invariant (dense gradient shapes),
             # so the state built here carries across every rung switch
             self.exchanger = self._exchanger_for(self._ctrl.index)
         else:
-            self.exchanger = GradientExchanger(
-                params, self.cfg, axis_name=self.axis_name,
+            # the composed-leg factory: hier configs get the two-tier
+            # wrapper on the (dcn, ici) mesh, flat configs the one-axis
+            # exchanger (exchange.leg_plan describes the result)
+            from deepreduce_tpu.exchange import build_exchanger
+
+            self.exchanger = build_exchanger(
+                params, self.cfg,
+                axis_name=self.axis_name,
                 num_workers=self.num_workers,
+                num_slices=self.mesh.shape["dcn"] if self.cfg.hier else None,
+                per_slice=self.mesh.shape["ici"] if self.cfg.hier else None,
             )
         residuals = self.exchanger.init_state(params)
         if residuals is not None:

@@ -1,0 +1,110 @@
+"""Composable exchange legs (deepreduce_tpu/exchange.py): the Exchanger
+protocol, the derived leg plans, and the one build factory every stack
+routes through. The plans are derived by inspection of BUILT stacks, so
+these tests double as a contract that wrapping (hier over flat, streaming
+over either) composes the way ARCHITECTURE.md's invariant table says."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepreduce_tpu.comm import GradientExchanger
+from deepreduce_tpu.comm_stream import StreamingExchange
+from deepreduce_tpu.config import DeepReduceConfig
+from deepreduce_tpu.exchange import (
+    Exchanger, Leg, build_exchanger, describe, leg_plan, wrap_streaming,
+)
+from deepreduce_tpu.parallel.hierarchical import HierarchicalExchanger
+
+W = 8
+
+BLOOM = dict(
+    deepreduce="index", index="bloom", compress_ratio=0.02, fpr=0.01,
+    bloom_blocked="mod", min_compress_size=100, memory="residual",
+)
+
+LIKE = {"g": jax.ShapeDtypeStruct((4096,), jnp.float32)}
+
+
+def _kinds(ex):
+    return [l.kind for l in leg_plan(ex)]
+
+
+def _axes(ex):
+    return [l.axis for l in leg_plan(ex)]
+
+
+def test_protocol_satisfied_by_both_stacks():
+    flat = build_exchanger(LIKE, DeepReduceConfig(**BLOOM), num_workers=W)
+    hier = build_exchanger(
+        LIKE, DeepReduceConfig(hier=True, **BLOOM),
+        num_slices=2, per_slice=4,
+    )
+    assert isinstance(flat, GradientExchanger)
+    assert isinstance(hier, HierarchicalExchanger)
+    assert isinstance(flat, Exchanger)
+    assert isinstance(hier, Exchanger)
+
+
+def test_build_hier_requires_geometry():
+    with pytest.raises(ValueError, match="num_slices"):
+        build_exchanger(LIKE, DeepReduceConfig(hier=True, **BLOOM))
+
+
+def test_flat_fused_plan():
+    ex = build_exchanger(LIKE, DeepReduceConfig(**BLOOM), num_workers=W)
+    assert _kinds(ex) == [
+        "codec-pack", "fused-allgather", "per-worker-loop", "wire",
+    ]
+    assert "data" in _axes(ex)
+
+
+def test_hier_plan_prepends_ici_leg():
+    ex = build_exchanger(
+        LIKE, DeepReduceConfig(hier=True, **BLOOM),
+        num_slices=2, per_slice=4,
+    )
+    plan = leg_plan(ex)
+    assert plan[0] == Leg("collective", "ici", "dense-psum")
+    # the wrapped flat plan rides the dcn axis
+    assert any(l.axis == "dcn" for l in plan[1:])
+
+
+def test_streaming_wrapper_prepends_schedule_leg():
+    cfg = DeepReduceConfig(
+        stream_exchange=True, bucket_bytes=4096, **BLOOM
+    )
+    ex = build_exchanger(LIKE, cfg, num_workers=W)
+    stream = wrap_streaming(ex)
+    assert isinstance(stream, StreamingExchange)
+    plan = leg_plan(stream)
+    assert plan[0].kind == "stream-hooks"
+    assert "bucketed-allgather" in [l.kind for l in plan]
+
+
+def test_composed_stream_hier_plan():
+    cfg = DeepReduceConfig(
+        stream_exchange=True, bucket_bytes=4096, hier=True, **BLOOM
+    )
+    hier = build_exchanger(LIKE, cfg, num_slices=2, per_slice=4)
+    stream = wrap_streaming(hier)
+    kinds = [l.kind for l in leg_plan(stream)]
+    assert kinds[0] == "stream-hooks"
+    assert "dense-psum" in kinds and "bucketed-allgather" in kinds
+    assert "stream-hooks" in describe(stream)
+
+
+def test_wrap_streaming_none_when_off():
+    ex = build_exchanger(LIKE, DeepReduceConfig(**BLOOM), num_workers=W)
+    assert wrap_streaming(ex) is None
+
+
+def test_masked_reowner_leg_on_resilient_sparse_rs():
+    cfg = DeepReduceConfig(
+        compressor="topk", compress_ratio=0.03, memory="none",
+        communicator="sparse_rs", deepreduce=None, resilience=True,
+    )
+    ex = build_exchanger(LIKE, cfg, num_workers=W)
+    kinds = _kinds(ex)
+    assert "masked-reowner" in kinds
+    assert any(k.startswith("sparse_rs:") for k in kinds)

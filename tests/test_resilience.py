@@ -250,6 +250,24 @@ def test_all_ones_mask_bitwise_identical_per_tensor_and_dense():
     )
 
 
+@pytest.mark.parametrize("rs_mode", ["sparse", "quantized", "oktopk"])
+def test_all_ones_mask_bitwise_identical_sparse_rs(rs_mode):
+    """The re-owned reduce-scatter routes through the full
+    GradientExchanger path (communicator='sparse_rs', resilience=True):
+    mask=ones is bitwise the mask-free exchange on every re-ownable
+    rs_mode — the identity the resilience-off-identical rule demands of
+    every masked communicator."""
+    g = _grads(seed=21, d=2048)
+    kw = dict(
+        compressor="topk", compress_ratio=0.03, memory="none",
+        communicator="sparse_rs", rs_mode=rs_mode, deepreduce=None,
+        resilience=True,
+    )
+    base, _ = _exchange_once(kw, g)
+    ones, _ = _exchange_once(kw, g, mask=np.ones(W, bool))
+    np.testing.assert_array_equal(base, ones)
+
+
 def test_dropped_worker_mass_redelivers_through_residual():
     """On a lossless codec (top-k at ratio 1.0): dropping worker 0 moves
     its ENTIRE gradient into its residual, the masked mean renormalizes by

@@ -428,16 +428,30 @@ def test_config_validates_rs_fields():
 
 
 def test_resilience_restriction_documents_shard_ownership():
-    """Satellite contract: masks CAN zero a worker's contribution but NOT
-    its shard *ownership* — qar/sparse_rs route shards via static
-    all_to_all/psum_scatter, so a masked owner black-holes its shard. The
-    config must refuse the combination and say why."""
-    for comm_name in ("sparse_rs", "qar"):
+    """The flat loop-decoded sparse_rs routes (sparse/quantized/oktopk/auto)
+    re-own a dropped worker's shards over the live set, so resilience=True
+    now constructs there.  Ownership has no re-routing path on qar (the
+    mean folds into one int8 psum_scatter with no per-worker decode row)
+    or on the adaptive/sketch routes (per-worker wire state) — the config
+    must still refuse those and say why."""
+    for rs_mode in ("sparse", "quantized", "oktopk", "auto"):
+        cfg = DeepReduceConfig(
+            compressor="topk", compress_ratio=0.03, memory="none",
+            communicator="sparse_rs", rs_mode=rs_mode, deepreduce=None,
+            resilience=True,
+        )
+        assert cfg.resilience
+    with pytest.raises(ValueError, match="shard owner"):
+        DeepReduceConfig(
+            compressor="none", compress_ratio=0.03, memory="none",
+            communicator="qar", deepreduce=None, resilience=True,
+        )
+    for rs_mode in ("adaptive", "sketch"):
         with pytest.raises(ValueError, match="shard owner"):
             DeepReduceConfig(
-                compressor="topk" if comm_name == "sparse_rs" else "none",
-                compress_ratio=0.03, memory="none", communicator=comm_name,
-                deepreduce=None, resilience=True,
+                compressor="topk", compress_ratio=0.03, memory="none",
+                communicator="sparse_rs", rs_mode=rs_mode, deepreduce=None,
+                resilience=True,
             )
 
 
@@ -514,3 +528,104 @@ def test_trainer_path_quantized_and_sketch_modes():
         assert 0 < vol < 1.0, (mode, vol)
         res = np.asarray(jax.tree_util.tree_leaves(new_state)[0])
         assert np.abs(res).sum() > 0, mode
+
+
+# --------------------------------------------------------------------- #
+# resilient routes: live-mask re-ownership of reduce-scatter shards
+# --------------------------------------------------------------------- #
+
+
+def _run_masked(flat_w, ratio, mask, rs_mode="sparse", headroom=2.0,
+                out_headroom=1.0, key=None):
+    """Masked exchange on the 8-way mesh; mask=None runs the mask-free
+    path on the SAME harness (bitwise comparability)."""
+    def spmd(g, *m):
+        mean, own, stats = sparse_rs.exchange(
+            g[0], "data", W, ratio=ratio, headroom=headroom,
+            out_headroom=out_headroom, rs_mode=rs_mode, key=key,
+            mask=m[0] if m else None,
+        )
+        return mean[None], own[None], stats
+
+    in_specs = (P("data"),) if mask is None else (P("data"), P())
+    fn = jax.jit(
+        shard_map(
+            spmd, mesh=_mesh(), in_specs=in_specs,
+            out_specs=(P("data"), P("data"), P()), check_vma=False,
+        )
+    )
+    args = (flat_w,) if mask is None else (flat_w, jnp.asarray(mask))
+    mean, own, stats = fn(*args)
+    return np.asarray(mean), np.asarray(own), stats
+
+
+def test_owner_permutation_identity_and_reroute():
+    """All-live is the identity map; a dropped worker's shard goes to the
+    live worker at rank (shard mod n_live) of the ascending live set, and
+    live workers always keep their own shards."""
+    ones = np.asarray(sparse_rs.owner_permutation(jnp.ones(W, bool), W))
+    np.testing.assert_array_equal(ones, np.arange(W))
+    mask = np.ones(W, bool)
+    mask[3] = False
+    om = np.asarray(sparse_rs.owner_permutation(jnp.asarray(mask), W))
+    live = [0, 1, 2, 4, 5, 6, 7]
+    for v in live:
+        assert om[v] == v
+    assert om[3] == live[3 % len(live)]
+
+
+@pytest.mark.parametrize("rs_mode", ["sparse", "quantized", "oktopk"])
+def test_masked_all_ones_bitwise_identical(rs_mode):
+    """mask=ones is the identity: the re-owned route returns bitwise the
+    mask-free route's mean AND own-transmitted, every rs_mode."""
+    rng = np.random.default_rng(11)
+    flat_w = jnp.asarray(rng.normal(size=(W, 4096)).astype(np.float32))
+    key = jax.random.PRNGKey(5) if rs_mode == "quantized" else None
+    base = _run_masked(flat_w, 0.03, None, rs_mode=rs_mode, key=key)
+    ones = _run_masked(
+        flat_w, 0.03, np.ones(W, bool), rs_mode=rs_mode, key=key
+    )
+    np.testing.assert_array_equal(base[0], ones[0])
+    np.testing.assert_array_equal(base[1], ones[1])
+
+
+def test_masked_drop_reowns_shards_exact_oracle():
+    """Ample budgets + worker 3 dropped: the masked sparse route equals
+    the mean-of-topk oracle over the LIVE workers exactly — including
+    coordinates in the dropped worker's shard range, which a deputy now
+    owns instead of black-holing (the old shard-ownership fence's failure
+    mode), renormalized by the live count."""
+    rng = np.random.default_rng(12)
+    d, ratio = 4096, 0.02
+    flat_w = rng.normal(size=(W, d)).astype(np.float32)
+    mask = np.ones(W, bool)
+    mask[3] = False
+    mean, _, _ = _run_masked(
+        jnp.asarray(flat_w), ratio, mask, headroom=float(W),
+        out_headroom=2.0 * W,
+    )
+    want = _oracle_mean_of_topk(flat_w[mask], ratio)
+    np.testing.assert_allclose(mean[0], want, rtol=1e-6, atol=1e-7)
+    # the dropped worker's shard range is populated by its deputy
+    S = sparse_rs.shard_size(d, W)
+    assert np.abs(want[3 * S:4 * S]).sum() > 0  # oracle has mass there
+    np.testing.assert_allclose(
+        mean[0][3 * S:4 * S], want[3 * S:4 * S], rtol=1e-6, atol=1e-7
+    )
+
+
+@pytest.mark.parametrize("rs_mode", ["sparse", "oktopk"])
+def test_masked_dropped_owner_transmits_nothing(rs_mode):
+    """Transmitted-mass conservation under re-ownership: a dropped
+    worker's own-transmitted is exactly zero, so EF keeps its ENTIRE
+    compensated gradient in residual (nothing silently lost), while live
+    workers still transmit and the mean carries only live mass."""
+    rng = np.random.default_rng(13)
+    flat_w = jnp.asarray(rng.normal(size=(W, 4096)).astype(np.float32))
+    mask = np.ones(W, bool)
+    mask[5] = False
+    mean, own, _ = _run_masked(flat_w, 0.03, mask, rs_mode=rs_mode)
+    np.testing.assert_array_equal(own[5], np.zeros_like(own[5]))
+    for v in (0, 1, 4, 7):
+        assert np.abs(own[v]).sum() > 0
+    assert np.isfinite(mean).all()

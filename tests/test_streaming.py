@@ -285,6 +285,100 @@ def test_streaming_on_two_axis_mesh():
 
 
 # --------------------------------------------------------------------- #
+# the composed stack: stream-over-hier == barrier hier, bitwise
+# --------------------------------------------------------------------- #
+
+
+def _one_step_hier(cfg, params, batch_w, *, step=0, seed=21):
+    """One full grad+exchange step with the HierarchicalExchanger on the
+    (2, 4) hybrid mesh; streamed when cfg.stream_exchange (the composed
+    stack — each bucket's ici psum + dcn gather dispatch from its backward
+    hook), else barrier-scheduled exactly as train.make_worker_step.
+    Returns np pytrees (agg, grads[W,...], residuals, dcn bits, ici bits).
+    """
+    from deepreduce_tpu.parallel.hierarchical import (
+        HierarchicalExchanger, make_hybrid_mesh,
+    )
+
+    tmap = jax.tree_util.tree_map
+    like = tmap(lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32), params)
+    ex = HierarchicalExchanger(like, cfg, num_slices=2, per_slice=4)
+    res0 = ex.init_state(tmap(lambda s: jnp.zeros(s.shape, s.dtype), like))
+    res0 = tmap(lambda r: jnp.broadcast_to(r[None], (W,) + r.shape), res0)
+    key = jax.random.PRNGKey(seed)
+    stream = StreamingExchange(ex) if cfg.stream_exchange else None
+    step_arr = jnp.asarray(step)
+
+    def spmd(p, b_w, res):
+        b = tmap(lambda x: x[0], b_w)
+        res = tmap(lambda r: r[0], res)
+        if stream is not None:
+            (loss, _), grads, agg, new_res, stats = (
+                stream.value_and_grad_exchange(
+                    _loss, p, {}, b, res, step=step_arr, key=key
+                )
+            )
+        else:
+            (loss, _), grads = jax.value_and_grad(_loss, has_aux=True)(
+                p, {}, b
+            )
+            agg, new_res, stats = ex.exchange(
+                grads, res, step=step_arr, key=key
+            )
+        return (
+            tmap(lambda x: x[None], agg),
+            tmap(lambda g: g[None], grads),
+            tmap(lambda r: r[None], new_res),
+            stats.total_bits,
+            stats.ici_bits,
+        )
+
+    spec = P(("dcn", "ici"))
+    fn = shard_map(
+        spmd,
+        mesh=make_hybrid_mesh(2, 4),
+        in_specs=(P(), spec, spec),
+        out_specs=(spec, spec, spec, P(), P()),
+        check_vma=False,
+    )
+    agg, grads, res, bits, ici_bits = jax.jit(fn)(params, batch_w, res0)
+    to_np = lambda t: tmap(np.asarray, t)
+    return (
+        to_np(agg), to_np(grads), to_np(res), float(bits), float(ici_bits)
+    )
+
+
+@pytest.mark.parametrize(
+    "codec_cfg", [BLOOM_CFG, QSGD_CFG], ids=["bloom-index", "bloom-qsgd-both"]
+)
+@pytest.mark.parametrize("order", ["trace", "reverse"])
+def test_stream_over_hier_bitwise_equals_barrier_hier(codec_cfg, order):
+    """The composed stack's one load-bearing contract: streaming the
+    buckets over the hierarchical (dcn, ici) legs — each bucket's dense
+    ICI slice-mean psum AND its compressed DCN gather dispatched from the
+    bucket's custom_vjp backward hook — is BITWISE identical to the
+    barrier-scheduled HierarchicalExchanger: aggregates, raw per-worker
+    grads, residuals, DCN wire bits, and ICI bits all equal, stochastic
+    qsgd value codec included (same per-tensor PRNG keys, same ici key
+    repair), under both bucket orders."""
+    params = _params(seed=17)
+    batch_w = _batches(seed=18)
+    base = dict(
+        memory="residual", bucket_bytes=4800, bucket_order=order,
+        hier=True, **codec_cfg,
+    )
+    out_s = _one_step_hier(
+        DeepReduceConfig(stream_exchange=True, **base), params, batch_w
+    )
+    out_b = _one_step_hier(DeepReduceConfig(**base), params, batch_w)
+    _assert_trees_equal(out_s[0], out_b[0])
+    _assert_trees_equal(out_s[1], out_b[1])
+    _assert_trees_equal(out_s[2], out_b[2])
+    assert out_s[3] == out_b[3]
+    assert out_s[4] == out_b[4]
+
+
+# --------------------------------------------------------------------- #
 # controller composition: one executable per rung, streaming on
 # --------------------------------------------------------------------- #
 
@@ -325,9 +419,22 @@ def test_streaming_config_validation():
             stream_exchange=True, bucket_bytes=4096, resilience=True,
             **BLOOM_CFG,
         )
+    # the composable stream-over-hier stack (dense ici, config-pinned
+    # bucketed-allgather dcn leg) constructs; any other hier shape under
+    # streaming still refuses
+    cfg = DeepReduceConfig(
+        stream_exchange=True, bucket_bytes=4096, hier=True, **BLOOM_CFG
+    )
+    assert cfg.stream_exchange and cfg.hier
     with pytest.raises(ValueError, match="hier"):
         DeepReduceConfig(
-            stream_exchange=True, bucket_bytes=4096, hier=True, **BLOOM_CFG
+            stream_exchange=True, bucket_bytes=4096, hier=True,
+            hier_ici="qar", **BLOOM_CFG,
+        )
+    with pytest.raises(ValueError, match="hier"):
+        DeepReduceConfig(
+            stream_exchange=True, bucket_bytes=4096, hier=True,
+            decode_strategy="ring", **BLOOM_CFG,
         )
     with pytest.raises(ValueError, match="fed"):
         DeepReduceConfig(
@@ -394,3 +501,58 @@ def test_overlap_fraction_hand_computed():
     # degenerate zero-wire measurement: everything is hidden by definition
     z = {"payload_bytes": 0.0, "t_encode_s": 0.0, "t_decode_s": 0.0}
     assert cm.overlap_fraction(z, 8, bw) == 1.0
+
+
+def test_stream_hier_step_time_composition():
+    """The composed model: compute hides the COMBINED ici+dcn wire.
+    At compute_time=0 the fused form IS hier_step_time('dense','fused');
+    for any compute it never exceeds the barrier-hier parent (the barrier
+    schedule hides nothing) nor what the same compute buys streaming-flat
+    on the W-wide gather; and the allgather-family fence rejects rs legs."""
+    from deepreduce_tpu import costmodel as cm
+
+    d, ns, ps, r = 4_000_000, 8, 4, 0.05
+    W = ns * ps
+    assert cm.stream_hier_step_time("fused", d, ns, ps, r) == (
+        cm.hier_step_time("dense", "fused", d, ns, ps, r)
+    )
+    m = {
+        "payload_bytes": 8.0 * int(d * r),
+        "t_encode_s": 0.0, "t_decode_s": 0.0,
+    }
+    for ct in (0.0, 0.01, 0.5, 100.0):
+        for dcn in ("fused", "bucketed"):
+            composed = cm.stream_hier_step_time(
+                dcn, d, ns, ps, r, compute_time=ct
+            )
+            assert composed <= cm.hier_step_time(
+                "dense", dcn, d, ns, ps, r
+            ) + 1e-12
+        assert cm.stream_hier_step_time(
+            "fused", d, ns, ps, r, compute_time=ct
+        ) <= cm.overlapped_step_time(m, W, compute_time=ct) + 1e-12
+    with pytest.raises(ValueError, match="allgather family"):
+        cm.stream_hier_step_time("sparse", d, ns, ps, r)
+
+
+def test_select_hier_plan_overlap_aware_flag():
+    """stream=False keeps the historical candidate table to the last
+    float (the calib-reselect audit pins it); stream=True re-prices ONLY
+    the composable dense+fused/bucketed cells, never upward."""
+    from deepreduce_tpu import costmodel as cm
+
+    d, ns, ps, r = 4_000_000, 8, 4, 0.05
+    base = cm.select_hier_plan(d, ns, ps, r)
+    again = cm.select_hier_plan(d, ns, ps, r, stream=False)
+    assert base["table"] == again["table"]
+    # compute_time already shaves the dcn leg in the barrier model for
+    # every candidate, so the fair baseline carries the same compute_time
+    # and differs from `aware` only by the stream flag.
+    base_ct = cm.select_hier_plan(d, ns, ps, r, stream=False, compute_time=0.5)
+    aware = cm.select_hier_plan(d, ns, ps, r, stream=True, compute_time=0.5)
+    for key, t in aware["table"].items():
+        ici, dcn = key.split("+")
+        if ici == "dense" and dcn in ("fused", "bucketed"):
+            assert t <= base_ct["table"][key] + 1e-12
+        else:
+            assert t == base_ct["table"][key]

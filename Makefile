@@ -1,4 +1,4 @@
-.PHONY: analyze analyze-quick matrix-check memcheck test test-quick telemetry-check chaos-check fedsim-check fedasync-check fedmt-check ctrl-check overlap-check calibrate-check slo-check
+.PHONY: analyze analyze-quick matrix-check memcheck test test-quick telemetry-check chaos-check fedsim-check fedasync-check fedmt-check pop-check ctrl-check overlap-check calibrate-check slo-check
 
 # full static-analysis gate: AST lint + jaxpr audit of every registered
 # codec/communicator config; writes ANALYSIS.json, exits nonzero on any
@@ -7,7 +7,7 @@
 # (chaos-check), the federated round smoke (fedsim-check) and the
 # composition-lattice legality matrix (matrix-check) so none of those
 # paths can rot while the gate stays green.
-analyze: memcheck matrix-check telemetry-check chaos-check fedsim-check fedasync-check fedmt-check slo-check ctrl-check overlap-check calibrate-check
+analyze: memcheck matrix-check telemetry-check chaos-check fedsim-check fedasync-check fedmt-check pop-check slo-check ctrl-check overlap-check calibrate-check
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.analysis
 
 # memory-liveness gate: the donation-aware liveness interpreter over the
@@ -78,6 +78,21 @@ fedmt-check:
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.fedsim --platform cpu check \
 		--tenants 2 --rounds 8 --track_dir $(FEDMT_CHECK_DIR)
 	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry summary $(FEDMT_CHECK_DIR)/mt-check
+
+# heterogeneous-population smoke: a skewed two-class population (planted
+# non-IID label mixtures, per-class latency rows, a 2x compute class)
+# through the async buffered tick on the 8-device CPU mesh — asserts the
+# exact on-device per-class participation histogram (its mass each tick
+# equals the tick's accepted count, every class served), churn recorded,
+# and a MID-STREAM checkpoint (buffer partially filled, class-id vector
+# riding the state) resumes BITWISE; then the telemetry CLI digests the
+# per-class rows (fed_pop_shares, fed_pop_residency_min).
+POP_CHECK_DIR := /tmp/drtpu_pop_check
+pop-check:
+	rm -rf $(POP_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.fedsim --platform cpu check \
+		--population --rounds 8 --track_dir $(POP_CHECK_DIR)
+	JAX_PLATFORMS=cpu python -m deepreduce_tpu.telemetry summary $(POP_CHECK_DIR)/check
 
 # SLO health-plane smoke: the async churn+chaos check run with the
 # in-driver HealthMonitor armed (--slo) — asserts the run ends healthy,

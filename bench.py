@@ -1855,6 +1855,203 @@ def fed_mt_sweep(quick: bool = False, workers: int = 8) -> dict:
     }
 
 
+def pop_sweep(quick: bool = False, workers: int = 8) -> dict:
+    """The heterogeneous-population serving arm (`--pop-sweep`): three
+    client populations through the async buffered tick at the SAME
+    population/cohort geometry as the committed async headline
+    (BENCH_FEDASYNC_r20.json: C=16384 against a 131072-client
+    population) — uniform (the degenerate single-class spec the bitwise
+    degeneracy contract pins to the population-free program), mild
+    non-IID label skew, and a pathological split (near-one-hot Dirichlet
+    label mixtures + per-class latency rows + a 2x compute class). Every
+    arm records its final teacher error against the uniform arm's and
+    whether it stays inside the loss band — the convergence-band
+    evidence the heterogeneity claim is conditioned on — plus the exact
+    on-device per-class participation shares (the f32[K] histogram that
+    rides the one fused psum) next to the spec's analytic population
+    weights."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from deepreduce_tpu.config import DeepReduceConfig
+    from deepreduce_tpu.fedsim.round import parse_class_latency, parse_latency
+    from deepreduce_tpu.fedsim.sim import FedSim, synthetic_linear_problem
+    from deepreduce_tpu.population.spec import PopulationSpec
+    from deepreduce_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    cm = _costmodel()
+    population = 1 << 17 if not quick else 1 << 12
+    C = 16384 if not quick else 256
+    dim, batch, local_steps = 256, 4, 2
+    chunk = 128 if not quick else 32
+    ticks = 6 if not quick else 3
+    latency = "0.5,0.3,0.2"
+    probs = parse_latency(latency)
+    # modeled client-side local-train latency (hidden behind the overlap
+    # ring; what the compute classes stretch) — stamped modeled
+    t_client_s = 1.0
+    mesh = Mesh(np.array(jax.devices()[:workers]), ("data",))
+    params0, data_fn, loss_fn = synthetic_linear_problem(dim, batch, local_steps)
+    w_true = jax.random.normal(jax.random.PRNGKey(42), (dim,))
+
+    specs = {
+        "uniform": '{"version": 1, "classes": [{"name": "uniform"}]}',
+        # label_shift is kept small: the per-sample mean shift adds a
+        # rank-one s*1 component to every feature row, so the data
+        # covariance's top eigenvalue grows as ~1 + s^2*dim — at dim=256
+        # and lr 0.1 the default shift of 1.0 would put SGD past its
+        # stability limit on purpose-built-divergent data rather than
+        # measuring heterogeneity
+        "mild_skew": (
+            '{"version": 1, "num_labels": 8, "label_shift": 0.05, '
+            '"classes": ['
+            '{"name": "bulk", "weight": 3.0, "data_alpha": 4.0}, '
+            '{"name": "tail", "weight": 1.0, "data_alpha": 1.0, '
+            '"data_bias": 2.0}]}'
+        ),
+        "pathological_skew": (
+            '{"version": 1, "num_labels": 8, "label_shift": 0.05, '
+            '"classes": ['
+            '{"name": "onehot", "weight": 1.0, "data_alpha": 0.05, '
+            '"data_bias": 8.0, "latency": "0.2,0.4,0.4", '
+            '"local_steps_mult": 2.0}, '
+            '{"name": "fast", "weight": 1.0, "data_alpha": 0.5, '
+            '"latency": "0.8,0.15,0.05"}]}'
+        ),
+    }
+
+    base = dict(
+        deepreduce="index", index="bloom", bloom_blocked="mod",
+        compress_ratio=0.25, fpr=0.01, memory="residual",
+        min_compress_size=8,
+        fed=True, fed_num_clients=population, fed_clients_per_round=C,
+        fed_local_steps=local_steps,
+        fed_async=True, fed_async_k=C, fed_async_alpha=0.5,
+        fed_async_latency=latency,
+    )
+    key = jax.random.PRNGKey(0)
+    loss_band = 0.15
+    arms = {}
+    up_client = 0.0
+    for label, spec_json in specs.items():
+        spec = PopulationSpec.load_any(spec_json)
+        cfg = DeepReduceConfig(pop_spec=spec_json, **base)
+        fs = FedSim(
+            loss_fn, cfg, cfg.fed_config(), optax.sgd(0.1), data_fn,
+            mesh=mesh, client_chunk=chunk,
+        )
+        _progress(f"pop-sweep: {label}: compiling tick")
+        with _span(f"bench/pop-sweep/{label}"):
+            state = fs.init(params0)
+            state, _ = fs.step(state, jax.random.fold_in(key, 0))
+            state, m = fs.step(state, jax.random.fold_in(key, 1))
+            state, hist, wall = fs.stream(state, key, ticks)
+        served = sum(float(h["clients"]) for h in hist)
+        rate = served / wall
+        err = float(
+            jnp.linalg.norm(state.params["w"] - w_true)
+            / jnp.linalg.norm(w_true)
+        )
+        if label == "uniform":
+            up_client = float(m["uplink_bytes"]) / max(float(m["clients"]), 1.0)
+        pop_tot = np.zeros(spec.num_classes)
+        for h in hist:
+            pop_tot += np.asarray(h["pop_hist"], dtype=np.float64)
+        shares = (pop_tot / max(float(pop_tot.sum()), 1.0)).tolist()
+        rows = (
+            parse_class_latency([c.latency for c in spec.classes], latency)
+            if spec.latency_on
+            else None
+        )
+        arms[label] = {
+            "pop_spec": json.loads(spec_json),
+            "num_classes": spec.num_classes,
+            "measured_wall_s": round(wall, 4),
+            "measured_clients_per_sec": round(rate, 1),
+            "final_w_rel_err": round(err, 4),
+            "pop_shares_measured": [round(s, 4) for s in shares],
+            "pop_weights_spec": [round(w, 4) for w in spec.weights],
+            "staleness_mean": round(
+                sum(float(h["staleness_mean"]) for h in hist) / len(hist), 4
+            ),
+            "modeled_100mbps_clients_per_sec": cm.fed_pop_async_clients_per_sec(
+                up_client, C, weights=spec.weights,
+                local_steps_mults=spec.local_steps_mults,
+                class_latency_rows=rows, t_client_s=t_client_s,
+                overlap_depth=len(probs), latency_probs=probs,
+            ),
+        }
+        _progress(
+            f"pop-sweep: {label}: "
+            f"{arms[label]['measured_clients_per_sec']} clients/s, "
+            f"w_err {arms[label]['final_w_rel_err']}, "
+            f"shares {arms[label]['pop_shares_measured']}"
+        )
+
+    uni_err = arms["uniform"]["final_w_rel_err"]
+    within = {
+        a: bool(arms[a]["final_w_rel_err"] <= uni_err + loss_band)
+        for a in arms
+    }
+    return {
+        "metric": "fedsim_pop_serving_clients_per_sec",
+        "value": arms["pathological_skew"]["measured_clients_per_sec"],
+        "unit": "clients/s",
+        "platform": "cpu",
+        "provenance": _provenance(
+            modeled=[
+                "arms.*.modeled_100mbps_clients_per_sec",
+                "t_client_s",
+            ],
+            measured=[
+                "arms.*.measured_wall_s",
+                "arms.*.measured_clients_per_sec",
+                "arms.*.final_w_rel_err",
+                "arms.*.pop_shares_measured",
+                "arms.*.staleness_mean",
+                "uplink_bytes_per_client",
+            ],
+        ),
+        "detail": {
+            "population": population,
+            "clients_per_round": C,
+            "dim": dim,
+            "batch": batch,
+            "local_steps": local_steps,
+            "workers": workers,
+            "client_chunk": chunk,
+            "ticks": ticks,
+            "fed_async_k": C,
+            "fed_async_alpha": 0.5,
+            "fed_async_latency": latency,
+            "t_client_s": t_client_s,
+            "uplink_bytes_per_client": round(up_client, 1),
+            "codec": "topk 25% + mod-blocked bloom, per-client EF residual bank",
+            "bw_bytes_per_s": cm.BW_100MBPS,
+            "cost_model": (
+                "population-aware buffered ingest max(wire, compute) with "
+                "the class-weighted compute stretch and mixture staleness "
+                "(costmodel.fed_pop_async_clients_per_sec); uniform "
+                "collapses exactly onto fed_async_clients_per_sec"
+            ),
+            "collective_contract": (
+                "one psum per tick on every arm; the exact K-class "
+                "participation histogram rides the fused tuple — operand "
+                "bytes 4*(n+7+D+K), +D more with per-class latency rows "
+                "(fedsim:population* audits, ANALYSIS.json)"
+            ),
+            "baseline_arm": "uniform",
+            "loss_band": loss_band,
+            "within_loss_band": within,
+            "all_arms_within_loss_band": bool(all(within.values())),
+            "arms": arms,
+        },
+    }
+
+
 def ctrl_sweep(quick: bool = False, workers: int = 8) -> dict:
     """The adaptive-controller convergence arm (`--ctrl-sweep`): one fixed
     run per ladder rung vs one adaptive run on the same deterministic
@@ -2154,6 +2351,14 @@ def main() -> None:
 
         force_platform("cpu", device_count=8)
         print(json.dumps(fed_mt_sweep(quick="--quick" in sys.argv)))
+        return
+    if "--pop-sweep" in sys.argv:
+        # standalone heterogeneous-population serving sweep: CPU-mesh
+        # only, one JSON record on stdout (committed as BENCH_POP_*.json)
+        from deepreduce_tpu.utils import force_platform
+
+        force_platform("cpu", device_count=8)
+        print(json.dumps(pop_sweep(quick="--quick" in sys.argv)))
         return
     if "--ctrl-sweep" in sys.argv:
         # standalone adaptive-controller convergence arm: CPU-mesh only,

@@ -725,6 +725,159 @@ def audit_fedsim_async_round(*, d: int = 512) -> List[TraceRecord]:
     return [trace_and_check("fedsim:async-round", fn, args, ctx, payload_bytes=pb)]
 
 
+def audit_fedsim_population(*, d: int = 512) -> List[TraceRecord]:
+    """The r25 heterogeneous-population plane keeps the one-psum contract
+    and re-pins its operand bytes by exactly the members the plane adds:
+
+    - sync round + population: the exact per-class participation
+      histogram (f32[K]) rides the fused tuple, so the operand bytes are
+      4*(n + 6 + K) B/worker — the r20 law 4*(n+6) plus 4*K. The class-id
+      vector enters as one extra i32[num_clients] operand sharded with
+      the residual bank; it adds NO collective (each worker reads only
+      its own slice).
+    - async tick + population: 4*(n + 7 + D + K) — the r23 staleness
+      histogram law plus the same 4*K.
+    - async tick + per-class latency rows: the transmit-level histogram
+      (f32[D], the exact per-level transmission counts the staleness
+      stats derive from once levels differ per class) also rides the
+      tuple: 4*(n + 7 + 2*D + K).
+
+    Codec count stays at TWO everywhere: the non-IID skew transform is a
+    per-client mean shift staged inside the vmapped generator — no extra
+    selection, no collective."""
+    import json as _json
+
+    import optax
+
+    from deepreduce_tpu.fedsim.sim import (
+        AsyncBuffer,
+        FedSim,
+        synthetic_linear_problem,
+    )
+
+    tmap = jax.tree_util.tree_map
+    K = 2
+    spec_of = lambda cls: _json.dumps(  # noqa: E731
+        {"version": 1, "num_labels": 8, "classes": cls}
+    )
+    pop_plain = spec_of([
+        {"name": "bulk", "weight": 3.0, "data_alpha": 0.5},
+        {"name": "skewed", "weight": 1.0, "data_alpha": 0.1,
+         "data_bias": 4.0, "local_steps_mult": 2.0},
+    ])
+    pop_latency = spec_of([
+        {"name": "bulk", "weight": 3.0, "data_alpha": 0.5,
+         "latency": "0.6,0.3,0.1"},
+        {"name": "skewed", "weight": 1.0, "data_alpha": 0.1,
+         "data_bias": 4.0, "latency": "0.2,0.5,0.3"},
+    ])
+
+    def build(pop_spec, fed_async):
+        kw = dict(
+            memory="residual",
+            fed=True,
+            fed_num_clients=64,
+            fed_clients_per_round=16,
+            fed_local_steps=2,
+            pop_spec=pop_spec,
+            **_FLAGSHIP,
+        )
+        if fed_async:
+            kw.update(
+                fed_async=True,
+                fed_async_k=40,
+                fed_async_alpha=0.5,
+                fed_async_latency="0.5,0.3,0.2",
+            )
+        cfg = DeepReduceConfig(**kw)
+        fed = cfg.fed_config()
+        params0, data_fn, loss_fn = synthetic_linear_problem(
+            d, 4, fed.local_steps
+        )
+        fs = FedSim(
+            loss_fn, cfg, fed, optax.sgd(0.1), data_fn,
+            mesh=audit_mesh(), axis=AXIS,
+        )
+        params_sds = tmap(lambda p: _sds(p.shape, p.dtype), params0)
+        bank_sds = tmap(
+            lambda p: _sds((fed.num_clients,) + p.shape, p.dtype),
+            params_sds,
+        )
+        n_elems = sum(
+            int(jnp.prod(jnp.array(p.shape))) if p.shape else 1
+            for p in jax.tree_util.tree_leaves(params_sds)
+        )
+        classes_sds = _sds((fed.num_clients,), jnp.int32)
+        return fs, params_sds, bank_sds, classes_sds, n_elems
+
+    records: List[TraceRecord] = []
+
+    def check(label, fs, args, pb):
+        ctx = AuditContext(
+            label=label,
+            allow_callbacks=False,
+            expect_collectives={"psum": 1},
+            wire_mode="collective",
+            expected_wire_bytes=pb,
+            num_workers=NUM_WORKERS,
+            expect_codec_invocations=2,
+            require_key_lineage=True,
+        )
+        records.append(
+            trace_and_check(label, fs.sharded_round_fn(), args, ctx,
+                            payload_bytes=pb)
+        )
+
+    # sync round: 4*(n + 6 + K)
+    fs, params_sds, bank_sds, classes_sds, n = build(pop_plain, False)
+    check(
+        "fedsim:population",
+        fs,
+        (params_sds, params_sds, bank_sds, None, _STEP,
+         _sds((2,), jnp.uint32), classes_sds),
+        4 * (n + 6 + K),
+    )
+
+    def buf_sds_of(fs, params_sds):
+        D = len(fs.latency_probs)
+        return AsyncBuffer(
+            delta_sum=params_sds,
+            weight=_sds((), jnp.float32),
+            count=_sds((), jnp.float32),
+            k=_sds((), jnp.float32),
+            version=_sds((), jnp.int32),
+            hist=tmap(lambda p: _sds((D,) + p.shape, p.dtype), params_sds),
+            stale_sum=_sds((), jnp.float32),
+            stale_max=_sds((), jnp.float32),
+            pending=_sds((), jnp.float32),
+        )
+
+    # async tick, global latency row shared by both classes:
+    # 4*(n + 7 + D + K)
+    fs, params_sds, bank_sds, classes_sds, n = build(pop_plain, True)
+    D = len(fs.latency_probs)
+    check(
+        "fedsim:population-async",
+        fs,
+        (params_sds, params_sds, bank_sds, None, _STEP,
+         _sds((2,), jnp.uint32), buf_sds_of(fs, params_sds), classes_sds),
+        4 * (n + 7 + D + K),
+    )
+
+    # async tick, per-class latency rows: the tx-level histogram rides
+    # too — 4*(n + 7 + 2*D + K)
+    fs, params_sds, bank_sds, classes_sds, n = build(pop_latency, True)
+    D = len(fs.latency_probs)
+    check(
+        "fedsim:population-latency",
+        fs,
+        (params_sds, params_sds, bank_sds, None, _STEP,
+         _sds((2,), jnp.uint32), buf_sds_of(fs, params_sds), classes_sds),
+        4 * (n + 7 + 2 * D + K),
+    )
+    return records
+
+
 def audit_fedsim_multitenant(
     *, d: int = 512, tenants: Tuple[int, ...] = (2, 4)
 ) -> List[TraceRecord]:
@@ -1659,6 +1812,12 @@ def audit_specs(quick: bool = False) -> List[Tuple[str, Callable[[], List[TraceR
             with_mask=True,
         ),
     )
+    # --- the r25 heterogeneous-population plane: one psum with the exact
+    # per-class participation histogram riding the fused tuple — operand
+    # bytes re-pinned to 4*(n+6+K) sync / 4*(n+7+D+K) async / +D more
+    # with per-class latency rows (registered last so the pre-existing
+    # record order — and ANALYSIS.json hashes — are stable) ---
+    add("fedsim:population", lambda: audit_fedsim_population())
     return specs
 
 

@@ -48,10 +48,10 @@ SCHEMA = "deepreduce_tpu/analysis-report/v1"
 
 # (axis name, value labels) in lexicographic cell order. Every label maps
 # to concrete config kwargs in `cell_kwargs`; the cross-product is the
-# probed lattice (4*3*2*2*6*4*2*2*2*2*2 = 36864 cells). New axes are
+# probed lattice (4*3*2*2*6*4*2*2*2*2*2*2 = 73728 cells). New axes are
 # appended LAST: product order then expands every pre-existing cell into
 # an adjacent (off, on) pair with the off plane first, so the old lattice
-# survives as the fed_mt=off plane and re-baselining can be diffed
+# survives as the population=off plane and re-baselining can be diffed
 # cell-by-cell.
 AXES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("communicator", ("allgather", "allreduce", "qar", "sparse_rs")),
@@ -65,6 +65,7 @@ AXES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("fed", ("off", "on")),
     ("fed_async", ("off", "on")),
     ("fed_mt", ("off", "on")),
+    ("population", ("off", "on")),
 )
 
 # ctrl + telemetry are host-side only (the audited jx-ctrl-ladder
@@ -163,6 +164,18 @@ def cell_kwargs(cell: Dict[str, str]) -> Dict[str, Any]:
         # fed=on the T=2 fleet rides the same jitted tick (sync AND
         # async planes), still exactly one psum.
         kw.update(fed_tenants=2)
+    if cell["population"] == "on":
+        # without fed=on this cell is ILLEGAL by construction
+        # (pop-needs-fed); with fed_mt=on it is ILLEGAL too (pop-vs-mt) —
+        # the probe measures exactly that. Two classes with non-IID skew
+        # staged and NO per-class latency rows, so the async plane keeps
+        # the 4*(n+7+D+K) law with no transmit-histogram term.
+        kw.update(
+            pop_spec='{"version": 1, "num_labels": 8, "classes": ['
+            '{"name": "bulk", "weight": 3.0, "data_alpha": 0.5}, '
+            '{"name": "skewed", "weight": 1.0, "data_alpha": 0.1, '
+            '"data_bias": 4.0}]}'
+        )
     return kw
 
 
@@ -324,7 +337,15 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
     batches the param-leaf sums plus the tenant-varying tuple scalars
     (nlive/nfail, +wsum and the D histogram counters when async, +2 wire
     scalars when the checksum makes wire accounting data-dependent) and
-    leaves the shape-static wire scalars unbatched."""
+    leaves the shape-static wire scalars unbatched.
+
+    On the population=on plane (fed=on, fed_mt=off — pop-vs-mt fences the
+    rest) the class-id vector rides as one extra i32[num_clients] operand
+    sharded with the bank, and the exact per-class participation
+    histogram adds K members to the fused tuple: 4*(n+6+K) sync,
+    4*(n+7+D+K) async (the lattice spec stages no per-class latency rows,
+    so the transmit-histogram term stays off — the fixed
+    fedsim:population-latency audit pins that +D separately)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -417,9 +438,13 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
         )
         return ja.trace_and_check(label, fn, args, ctx, payload_bytes=pb)
     # async adds wsum + the D staleness-histogram counters to the fused
-    # tuple (r23 re-pin: the old law was n_elems + 7 when async)
+    # tuple (r23 re-pin: the old law was n_elems + 7 when async); the
+    # population plane adds its exact K-class participation histogram
+    # (r25 re-pin: +4*K B/worker)
+    K = fs.pop.num_classes if fs.pop is not None else 0
     pb = 4 * (
-        n_elems + 6 + ((1 + len(fs.latency_probs)) if cfg.fed_async else 0)
+        n_elems + 6 + K
+        + ((1 + len(fs.latency_probs)) if cfg.fed_async else 0)
     )
     args = (
         params_sds,
@@ -449,6 +474,10 @@ def _trace_fed(label: str, cfg: DeepReduceConfig, cell: Dict[str, str]):
                 pending=sc(),
             ),
         )
+    if fs.pop is not None:
+        # class-id vector, i32[num_clients] sharded with the bank — one
+        # extra operand, no extra collective
+        args = args + (ja._sds((fed.num_clients,), jnp.int32),)
     ctx = AuditContext(
         label=label,
         wire_mode="collective",

@@ -113,6 +113,19 @@ REASON_CODES: Dict[str, str] = {
     "fed-mt-cohort-syntax":
         "fed_mt_cohort failed the per-tenant list parse or has a size "
         "outside [1, fed_clients_per_round]",
+    "pop-needs-fed": "pop_spec without the federated serving path",
+    "pop-knobs-disengaged":
+        "pop_* knob(s) (or per-class latency rows) without their consumer",
+    "pop-vs-mt":
+        "pop_spec with fed_tenants >= 1 (per-class and per-tenant "
+        "heterogeneity do not compose yet)",
+    "pop-labels-range": "pop_labels/num_labels outside its legal range",
+    # population spec-file rejections (population/spec.py): the spec
+    # parser raises these so a typo'd population spec fails loudly instead
+    # of silently serving an IID population
+    "pop-spec-syntax": "population spec failed PopulationSpec parse",
+    "pop-spec-range": "population spec value outside its legal range",
+    "pop-latency-syntax": "a per-class latency row failed parse_latency",
     "slo-needs-fed": "slo_spec without the federated serving path",
     "slo-knobs-disengaged": "slo_* override knob(s) without slo_spec",
     "slo-window-range": "slo_window < 0",
@@ -485,6 +498,21 @@ class DeepReduceConfig:
     # one static [C]-shaped program; c_t is a traced f32[T] operand. "" =
     # every tenant runs the full cohort, and NO gate ops are staged.
     fed_mt_cohort: str = ""
+    # heterogeneous population plane (deepreduce_tpu.population): a
+    # schema-validated PopulationSpec — a JSON file path OR an inline JSON
+    # object (leading '{') — assigning every client in the residual bank
+    # to a class with three heterogeneity axes: Dirichlet data skew (the
+    # in-trace non-IID generator), a per-class latency row (replacing the
+    # single global fed_async_latency for that class's clients), and a
+    # compute multiplier priced by costmodel. None (default) is the IID
+    # population — the round/tick programs are byte-identical to a build
+    # without the subsystem (pinned by the fedsim:round / async-round
+    # audit specs), and the uniform single-class spec is bitwise identical
+    # to None (params AND residual bank, sync and async).
+    pop_spec: Optional[str] = None
+    # label-universe override for the non-IID generator (>= 2); 0
+    # (default) keeps the spec file's num_labels
+    pop_labels: int = 0
     # adaptive compression controller (deepreduce_tpu.controller): every
     # `telemetry_every` steps the Trainer feeds the fetched
     # MetricAccumulators window delta to a host-side controller that moves
@@ -1152,6 +1180,64 @@ class DeepReduceConfig:
                     "effective cohort must be an integer in [1, "
                     f"fed_clients_per_round={self.fed_clients_per_round}]"
                 )
+        # --- heterogeneous population plane (per-class clients) ---
+        if self.pop_labels != 0 and self.pop_spec is None:
+            raise ConfigError(
+                "pop-knobs-disengaged",
+                f"pop_labels={self.pop_labels} overrides the population "
+                "spec's label universe and would be silently ignored with "
+                "pop_spec=None — set pop_spec (or drop the knob)"
+            )
+        if self.pop_spec is not None:
+            if not self.fed:
+                raise ConfigError(
+                    "pop-needs-fed",
+                    "pop_spec assigns the federated client population to "
+                    "heterogeneity classes — there is no population to "
+                    "classify with fed=False (set the fed_* geometry too)"
+                )
+            if self.fed_tenants >= 1:
+                raise ConfigError(
+                    "pop-vs-mt",
+                    "pop_spec with fed_tenants >= 1: per-class and "
+                    "per-tenant heterogeneity do not compose yet — the "
+                    "class-id vector is sharded with the single-tenant "
+                    "residual bank. Run populations single-tenant (or drop "
+                    "pop_spec)"
+                )
+            if self.pop_labels < 0 or self.pop_labels == 1:
+                raise ConfigError(
+                    "pop-labels-range",
+                    f"pop_labels must be 0 (keep the spec value) or >= 2, "
+                    f"got {self.pop_labels}"
+                )
+            # full spec parse at construction (deferred import mirrors the
+            # parse_latency check above): inline JSON and spec files both
+            # fail HERE with their registered pop-spec-* codes, not three
+            # layers deep inside the driver build
+            from deepreduce_tpu.population.spec import PopulationSpec
+
+            spec = PopulationSpec.load_any(self.pop_spec)
+            if spec.latency_on and not self.fed_async:
+                raise ConfigError(
+                    "pop-knobs-disengaged",
+                    "the population spec carries per-class latency row(s), "
+                    "which configure the async staleness draw and would be "
+                    "silently ignored with fed_async=False — set "
+                    "fed_async=True (or drop the class latency rows)"
+                )
+            if spec.latency_on:
+                from deepreduce_tpu.fedsim.round import parse_class_latency
+
+                try:
+                    parse_class_latency(
+                        [c.latency for c in spec.classes],
+                        self.fed_async_latency,
+                    )
+                except ConfigError:
+                    raise
+                except ValueError as e:
+                    raise ConfigError("pop-latency-syntax", str(e)) from e
         # --- SLO health plane: host-side monitor over the fed tick stream --
         slo_engaged = [
             name for name in ("slo_window", "slo_hysteresis")

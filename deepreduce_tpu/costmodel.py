@@ -614,6 +614,131 @@ def fed_mt_clients_per_sec(
 
 
 # ---------------------------------------------------------------------------
+# Heterogeneous population pricing (the compute-class axis of a
+# PopulationSpec). Classes differ in local-step multipliers and latency
+# rows; the serving model prices both as population-weighted expectations.
+# A uniform population (every multiplier 1.0, no per-class rows) collapses
+# EXACTLY — same float expressions — to the population-free estimators,
+# the costmodel half of the bitwise IID-degeneracy contract.
+# ---------------------------------------------------------------------------
+
+
+def pop_compute_factor(weights, local_steps_mults) -> float:
+    """Population-weighted local-compute stretch: ``Σ w̄_k · mult_k`` over
+    the classes, the factor one client's expected local-train latency
+    grows by when compute classes are heterogeneous. Returns the EXACT
+    literal 1.0 when every multiplier is 1.0 (no ``Σ w̄_k`` rounding), so
+    a uniform population prices bitwise like no population at all."""
+    if len(weights) != len(local_steps_mults):
+        raise ValueError(
+            f"pop_compute_factor: {len(weights)} class weights vs "
+            f"{len(local_steps_mults)} local-step multipliers"
+        )
+    if not weights:
+        raise ValueError("pop_compute_factor: need at least one class")
+    if all(float(m) == 1.0 for m in local_steps_mults):
+        return 1.0
+    total = float(sum(weights))
+    if total <= 0.0:
+        raise ValueError(f"pop_compute_factor: weights sum to {total}")
+    return sum(
+        float(w) / total * float(m)
+        for w, m in zip(weights, local_steps_mults)
+    )
+
+
+def pop_expected_staleness(weights, class_latency_rows) -> float:
+    """Mixture mean staleness of a heterogeneous population: the
+    class-weighted expectation of each class's `expected_staleness` —
+    what E[tau] becomes when the latency distribution is per-class."""
+    if len(weights) != len(class_latency_rows):
+        raise ValueError(
+            f"pop_expected_staleness: {len(weights)} class weights vs "
+            f"{len(class_latency_rows)} latency rows"
+        )
+    if not weights:
+        raise ValueError("pop_expected_staleness: need at least one class")
+    total = float(sum(weights))
+    if total <= 0.0:
+        raise ValueError(f"pop_expected_staleness: weights sum to {total}")
+    return sum(
+        float(w) / total * expected_staleness(row)
+        for w, row in zip(weights, class_latency_rows)
+    )
+
+
+def fed_pop_clients_per_sec(
+    uplink_bytes_per_client: float,
+    clients: int,
+    bw: float = BW_100MBPS,
+    *,
+    weights=(1.0,),
+    local_steps_mults=(1.0,),
+    t_client_s: float = 0.0,
+    downlink_bytes: float = 0.0,
+    server_links: int = 1,
+) -> float:
+    """Population-aware synchronous serving throughput: the cohort barrier
+    waits for the SLOWEST compute class's clients, priced as the weighted
+    compute stretch on `t_client_s`. Delegates to `fed_clients_per_sec`,
+    so a uniform population collapses exactly."""
+    factor = pop_compute_factor(weights, local_steps_mults)
+    t = t_client_s if factor == 1.0 else t_client_s * factor
+    return fed_clients_per_sec(
+        uplink_bytes_per_client,
+        clients,
+        bw,
+        t_client_s=t,
+        downlink_bytes=downlink_bytes,
+        server_links=server_links,
+    )
+
+
+def fed_pop_async_clients_per_sec(
+    uplink_bytes_per_client: float,
+    k: int,
+    bw: float = BW_100MBPS,
+    *,
+    weights=(1.0,),
+    local_steps_mults=(1.0,),
+    class_latency_rows=None,
+    t_client_s: float = 0.0,
+    downlink_bytes: float = 0.0,
+    server_links: int = 1,
+    overlap_depth: int = 1,
+    latency_probs=(1.0,),
+) -> float:
+    """Population-aware buffered-async serving throughput: compute classes
+    stretch the client latency by the weighted factor, and per-class
+    latency rows (when given) replace E[tau] with the mixture expectation.
+    With `class_latency_rows=None` and unit multipliers this IS
+    `fed_async_clients_per_sec` (exact delegation — the collapse half of
+    the degeneracy contract)."""
+    factor = pop_compute_factor(weights, local_steps_mults)
+    t = t_client_s if factor == 1.0 else t_client_s * factor
+    if class_latency_rows is None:
+        return fed_async_clients_per_sec(
+            uplink_bytes_per_client,
+            k,
+            bw,
+            t_client_s=t,
+            downlink_bytes=downlink_bytes,
+            server_links=server_links,
+            overlap_depth=overlap_depth,
+            latency_probs=latency_probs,
+        )
+    wire = (k * uplink_bytes_per_client + downlink_bytes) / (
+        bw * max(server_links, 1)
+    )
+    depth = max(int(overlap_depth), 1)
+    compute = (
+        t * (1.0 + pop_expected_staleness(weights, class_latency_rows))
+        / depth
+    )
+    return k / max(max(wire, compute), 1e-12)
+
+
+# ---------------------------------------------------------------------------
 # Per-rs_mode static wire accounting. These return the per-worker
 # *injection* bytes of every collective the route issues — the same
 # numbers GradientExchanger.payload_bytes() reports and the

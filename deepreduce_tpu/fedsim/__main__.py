@@ -98,6 +98,9 @@ def _slo_report(rec, w_rel_err):
     hist = rec.get("staleness_hist")
     if isinstance(hist, list):
         rep["staleness_hist"] = hist
+    pop = rec.get("pop_hist")
+    if isinstance(pop, list):
+        rep["pop_hist"] = pop
     return rep
 
 
@@ -132,6 +135,24 @@ def _run_check(args):
             fed_async_k=int(2.2 * args.clients_per_round),
             fed_async_alpha=0.5,
             fed_async_latency="0.5,0.3,0.2",
+        )
+    pop = bool(getattr(args, "population", False))
+    if pop:
+        # heterogeneous two-class smoke (make pop-check): planted label
+        # skew on both classes plus per-class latency rows — which move
+        # the staleness stats onto the psum'd transmit-level histogram —
+        # and a 2x compute class; the exact per-class participation
+        # histogram is asserted against the tick's accepted count below
+        overrides.update(
+            pop_spec=(
+                '{"version": 1, "num_labels": 4, "label_shift": 0.05, '
+                '"classes": ['
+                '{"name": "fast", "weight": 3.0, "data_alpha": 2.0, '
+                '"latency": "0.6,0.3,0.1"}, '
+                '{"name": "slow", "weight": 1.0, "data_alpha": 0.2, '
+                '"data_bias": 4.0, "latency": "0.2,0.5,0.3", '
+                '"local_steps_mult": 2.0}]}'
+            ),
         )
     cfg = _build_cfg(**overrides)
     fed = cfg.fed_config()
@@ -262,6 +283,13 @@ def _run_check(args):
                 jax.tree_util.tree_leaves(state2.buffer),
             )
         )
+    if state.classes is not None:
+        # population: the class-id vector restores bitwise too (it is a
+        # deterministic function of (spec, N), but it rides the
+        # checkpoint as a state leaf and must round-trip exactly)
+        resumed_equal = resumed_equal and bool(
+            jnp.all(state.classes == state2.classes)
+        )
 
     summary = fs.summary(state)
     run.finish(summary)
@@ -307,6 +335,28 @@ def _run_check(args):
                     saved_buffer_fill and saved_buffer_fill > 0
                     and saved_stale_sum and saved_stale_sum > 0
                 ),
+            }
+        )
+    if pop:
+        pop_rows = [
+            rec["pop_hist"]
+            for rec in rounds_hist
+            if isinstance(rec.get("pop_hist"), list)
+        ]
+        K = len(pop_rows[0]) if pop_rows else 0
+        pop_total = [sum(r[k] for r in pop_rows) for k in range(K)]
+        checks.update(
+            {
+                # the on-device per-class histogram is EXACT: its mass
+                # each tick is the tick's accepted-contribution count
+                "pop_hist_exact": bool(pop_rows)
+                and all(
+                    abs(sum(rec["pop_hist"]) - rec["clients"]) < 1e-3
+                    for rec in rounds_hist
+                    if isinstance(rec.get("pop_hist"), list)
+                ),
+                "pop_all_classes_served": bool(pop_total)
+                and all(t > 0 for t in pop_total),
             }
         )
     if args.slo:
@@ -385,6 +435,13 @@ def _run_check(args):
             "applies": sum(rec.get("applied", 0.0) for rec in rounds_hist),
             "checkpoint_buffer_fill": saved_buffer_fill,
             "checkpoint_stale_sum": saved_stale_sum,
+        }
+    if pop:
+        grand = max(sum(pop_total), 1.0)
+        report["population"] = {
+            "pop_spec": json.loads(cfg.pop_spec),
+            "pop_hist_total": pop_total,
+            "pop_shares": [t / grand for t in pop_total],
         }
     if args.slo:
         report["slo"] = {
@@ -728,12 +785,22 @@ def main(argv=None) -> int:
         help="SLOSpec JSON path for --slo; default: the embedded "
              "churn+chaos smoke spec")
     p_check.add_argument(
+        "--population", action="store_true",
+        help="heterogeneous-population smoke: skewed two-class spec with "
+             "per-class latency rows through the async tick — churn, "
+             "exact per-class participation histogram, mid-stream "
+             "bitwise resume (make pop-check); implies --async")
+    p_check.add_argument(
         "--tenants", type=int, default=0,
         help="multi-tenant smoke: T heterogeneous async populations "
              "through the one vmapped tick — join/leave without retrace, "
              "mid-fill multi-tenant bitwise resume, per-tenant telemetry "
              "rows (make fedmt-check)")
     args = ap.parse_args(argv)
+    if getattr(args, "population", False):
+        # the per-class latency rows (the tx-histogram path) only engage
+        # on the async tick; the sync degeneracy is pinned by the tests
+        args.use_async = True
     if args.platform:
         from deepreduce_tpu.utils import force_platform
 

@@ -32,7 +32,8 @@ Degradation semantics (mirrors train.py's worker-dropout story):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, List, Optional, Tuple
+import math
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -329,34 +330,44 @@ def _mask_where(gate_vec: jax.Array, new: jax.Array, old: jax.Array) -> jax.Arra
 _LATENCY_TAG = 0x57A1E
 
 
-def parse_latency(spec: str) -> Tuple[float, ...]:
+def parse_latency(spec: str, name: str = "fed_async_latency") -> Tuple[float, ...]:
     """Parse a latency distribution spec: comma-separated non-negative
     weights over staleness tau = 0, 1, 2, ..., normalized to probabilities.
     "" (the default) is zero latency: (1.0,). The tuple length is the
     overlap depth D — the number of past model versions kept in the w_hist
-    ring, so tau is bounded by D-1 *by construction* (no runtime clamp)."""
+    ring, so tau is bounded by D-1 *by construction* (no runtime clamp).
+
+    This is THE latency row parser: the global `fed_async_latency` knob,
+    the per-tenant rows (`parse_tenant_latency`), and the per-class rows
+    (`parse_class_latency`) all route through it — `name` labels the
+    failing knob in the message."""
     if not spec:
         return (1.0,)
     try:
         weights = [float(tok) for tok in spec.split(",")]
     except ValueError as e:
         raise ValueError(
-            f"fed_async_latency={spec!r}: every comma-separated token must "
+            f"{name}={spec!r}: every comma-separated token must "
             f"be a float weight ({e})"
         ) from None
+    if any(not math.isfinite(w) for w in weights):
+        raise ValueError(
+            f"{name}={spec!r}: weights must be finite — nan/inf cannot "
+            "normalize to a probability row"
+        )
     if any(w < 0 for w in weights):
         raise ValueError(
-            f"fed_async_latency={spec!r}: weights are unnormalized "
+            f"{name}={spec!r}: weights are unnormalized "
             "probabilities and must be >= 0"
         )
     total = sum(weights)
     if total <= 0:
         raise ValueError(
-            f"fed_async_latency={spec!r}: weights must not all be zero"
+            f"{name}={spec!r}: weights must not all be zero"
         )
     if len(weights) > 64:
         raise ValueError(
-            f"fed_async_latency={spec!r}: {len(weights)} staleness levels — "
+            f"{name}={spec!r}: {len(weights)} staleness levels — "
             "the w_hist ring keeps one full model copy per level; cap is 64"
         )
     return tuple(w / total for w in weights)
@@ -438,17 +449,38 @@ def parse_tenant_floats(
     return tuple(vals)
 
 
+def _pad_latency_rows(
+    rows: Sequence[Tuple[float, ...]]
+) -> Tuple[Tuple[float, ...], ...]:
+    """Zero-pad parsed latency rows to their common overlap depth D = max
+    over rows. Padding is draw-preserving: the padded tail adds no
+    probability mass, so a row's staleness draws match the ones its
+    unpadded spec would produce. Shared by the per-tenant and per-class
+    row parsers (one padding rule, two row families)."""
+    depth = max(len(r) for r in rows)
+    return tuple(r + (0.0,) * (depth - len(r)) for r in rows)
+
+
 def parse_tenant_latency(
     spec: str, tenants: int, default: str
 ) -> Tuple[Tuple[float, ...], ...]:
     """Parse a semicolon-separated list of per-tenant latency specs (each
     one a `parse_latency` comma list), zero-padded to the fleet's common
     overlap depth D = max over tenants. '' broadcasts `default`; a single
-    spec broadcasts. Zero-padding is draw-preserving: the padded tail adds
-    no probability mass, so a tenant's staleness draws match the ones its
-    unpadded spec would produce."""
+    spec broadcasts. An EMPTY row inside a multi-row spec is rejected —
+    it would silently read as zero latency for that tenant."""
     src = spec if spec else (default or "")
-    rows = [parse_latency(tok) for tok in src.split(";")] if src else [(1.0,)]
+    if src:
+        toks = src.split(";")
+        if len(toks) > 1 and any(not t for t in toks):
+            raise ValueError(
+                f"fed_mt_latency={spec!r}: empty per-tenant row — every "
+                "semicolon-separated row needs at least one weight (an "
+                "empty row would silently mean zero latency)"
+            )
+        rows = [parse_latency(tok, name="fed_mt_latency") for tok in toks]
+    else:
+        rows = [(1.0,)]
     if len(rows) == 1:
         rows = rows * tenants
     if len(rows) != tenants:
@@ -457,8 +489,24 @@ def parse_tenant_latency(
             f"specs for a {tenants}-tenant fleet — give 1 (broadcast) or "
             f"exactly {tenants}"
         )
-    depth = max(len(r) for r in rows)
-    return tuple(r + (0.0,) * (depth - len(r)) for r in rows)
+    return _pad_latency_rows(rows)
+
+
+def parse_class_latency(
+    class_specs: Sequence[str], default: str = ""
+) -> Tuple[Tuple[float, ...], ...]:
+    """Parse per-CLASS latency rows for the heterogeneous population
+    plane: one `parse_latency` comma list per class, '' inheriting the
+    global `default` (the fed_async_latency knob), all zero-padded to the
+    population's common overlap depth D = max over classes. The returned
+    f32-ready rows ride as the per-class CDF table the async tick draws
+    each client's staleness from (by the client's class)."""
+    base = parse_latency(default or "")
+    rows = [
+        parse_latency(s, name=f"population class[{i}] latency") if s else base
+        for i, s in enumerate(class_specs)
+    ]
+    return _pad_latency_rows(rows)
 
 
 def make_async_client_step(

@@ -48,10 +48,12 @@ from deepreduce_tpu.fedsim.codec_tree import TreeCodec
 from deepreduce_tpu.fedsim.round import (
     FedConfig,
     WIRE_FIELDS,
+    _LATENCY_TAG,
     cohort_updates,
     draw_latency,
     make_async_client_step,
     make_client_step,
+    parse_class_latency,
     parse_latency,
     parse_tenant_floats,
     parse_tenant_latency,
@@ -137,6 +139,10 @@ class FedSimState:
     # asynchronous aggregation buffer; None in synchronous mode, so the
     # sync state's pytree leaves (and checkpoints) are unchanged
     buffer: Optional[AsyncBuffer] = None
+    # population class-id vector, i32[num_clients] sharded with the
+    # residual bank; None when the population plane is off (same
+    # leaf-list-unchanged contract as `buffer`)
+    classes: Optional[jax.Array] = None
 
     def tree_flatten(self):
         return (
@@ -147,6 +153,7 @@ class FedSimState:
                 self.round,
                 self.telemetry,
                 self.buffer,
+                self.classes,
             ),
             None,
         )
@@ -314,6 +321,44 @@ class FedSim:
         self.latency_probs = parse_latency(
             getattr(cfg_c2s, "fed_async_latency", "") or ""
         )
+        # heterogeneous population plane: the spec is STATIC (class table,
+        # skew concentrations, per-class latency rows baked into the trace);
+        # only the class-id vector rides as a traced operand. The config
+        # fences already guarantee fed=True, single-tenant, and fed_async
+        # whenever a class carries a latency row. None everywhere below
+        # keeps every population-free build byte-identical.
+        self.pop = None
+        self.pop_data_fn = None
+        self.pop_latency_rows = None
+        pop_spec = getattr(cfg_c2s, "pop_spec", None)
+        if pop_spec is not None:
+            from deepreduce_tpu.population.sampler import (
+                make_population_data_fn,
+            )
+            from deepreduce_tpu.population.spec import PopulationSpec
+
+            spec = PopulationSpec.load_any(pop_spec)
+            labels = int(getattr(cfg_c2s, "pop_labels", 0) or 0)
+            if labels:
+                spec = spec.with_overrides(num_labels=labels)
+            self.pop = spec
+            self.pop_data_fn = make_population_data_fn(spec, data_fn)
+            if self.fed_async and spec.latency_on:
+                rows = parse_class_latency(
+                    [c.latency for c in spec.classes],
+                    getattr(cfg_c2s, "fed_async_latency", "") or "",
+                )
+                # one common overlap depth D across the class rows AND the
+                # global default row: ring depth and accumulator sizing both
+                # key off len(self.latency_probs), so zero-pad everything to
+                # the deepest distribution in play
+                D = max(len(rows[0]), len(self.latency_probs))
+                self.pop_latency_rows = tuple(
+                    r + (0.0,) * (D - len(r)) for r in rows
+                )
+                self.latency_probs = tuple(self.latency_probs) + (0.0,) * (
+                    D - len(self.latency_probs)
+                )
         # multi-tenant serving: stack T populations through the one tick
         # (0 = the single-tenant driver, whose build path is untouched)
         self.tenants = int(getattr(cfg_c2s, "fed_tenants", 0) or 0)
@@ -402,12 +447,27 @@ class FedSim:
         if self.cfg_c2s.telemetry:
             # async mode grows the accumulator's staleness-histogram vector
             # to the latency depth D (f32[0] otherwise — sync fetch/derive
-            # output is unchanged)
+            # output is unchanged); the population plane adds a per-class
+            # participation vector (None when off — no extra leaf)
             acc = MetricAccumulators.zeros(
-                num_stale_levels=len(self.latency_probs) if self.fed_async else 0
+                num_stale_levels=len(self.latency_probs) if self.fed_async else 0,
+                num_pop_classes=(
+                    self.pop.num_classes if self.pop is not None else 0
+                ),
             )
         if self.checksum or self.chaos is not None:
             self.build_layout(params)
+        classes = None
+        if self.pop is not None:
+            from deepreduce_tpu.population.sampler import class_assignments
+
+            classes = class_assignments(self.pop, self.fed.num_clients)
+            if self.mesh is not None:
+                # sharded exactly like the residual bank: worker w owns the
+                # class ids of its contiguous client stratum
+                classes = jax.device_put(
+                    classes, NamedSharding(self.mesh, P(self.axis))
+                )
         w_ref = jax.tree_util.tree_map(jnp.array, params)
         buffer = self._init_buffer(w_ref) if self.fed_async else None
         self._round = self._build_async(params) if self.fed_async else self._build(params)
@@ -418,6 +478,7 @@ class FedSim:
             round=jnp.zeros((), jnp.int32),
             telemetry=acc,
             buffer=buffer,
+            classes=classes,
         )
 
     def _init_buffer(self, w_ref: Any) -> AsyncBuffer:
@@ -556,7 +617,10 @@ class FedSim:
 
     # ------------------------------------------------------------------ #
 
-    def _round_body(self, params, w_ref, bank, acc, rnd, key, widx, *, cohort=None):
+    def _round_body(
+        self, params, w_ref, bank, acc, rnd, key, widx,
+        *, cohort=None, classes_local=None,
+    ):
         fed = self.fed
         C = fed.clients_per_round
         C_local, n_local = self.c_local, self.n_local
@@ -581,10 +645,22 @@ class FedSim:
         positions = jnp.uint32(widx * C_local) + jnp.arange(C_local, dtype=jnp.uint32)
 
         # --- synthesize the sampled clients' local datasets from their
-        # global ids (the population never materializes)
-        batches = jax.vmap(
-            lambda g: self.data_fn(g, rnd, jax.random.fold_in(key_data, g))
-        )(gids)
+        # global ids (the population never materializes); with the
+        # population plane engaged the class id rides into the generator
+        # (gather against this worker's class-id shard — purely local,
+        # exactly like the residual gather below)
+        cls_sampled = None
+        if classes_local is not None:
+            cls_sampled = classes_local[ids_local]
+            batches = jax.vmap(
+                lambda g, c: self.pop_data_fn(
+                    g, c, rnd, jax.random.fold_in(key_data, g)
+                )
+            )(gids, cls_sampled)
+        else:
+            batches = jax.vmap(
+                lambda g: self.data_fn(g, rnd, jax.random.fold_in(key_data, g))
+            )(gids)
         res_stack = (
             jax.tree_util.tree_map(lambda r: r[ids_local], bank)
             if self.use_res
@@ -639,13 +715,34 @@ class FedSim:
         nlive = jnp.sum(live)
         sent = jnp.sum(part_local) if part_local is not None else jnp.float32(C_local)
         nfail = sent - nlive  # transmitted but rejected by the checksum
+        # exact per-class participation histogram of ACCEPTED contributions
+        # in this worker's stratum, f32[K] — one extra member of the fused
+        # psum below (the fedsim:population audit spec re-pins the round's
+        # collective law to 4*(n_elems+6+K) bytes; still ONE collective)
+        pop_hist = None
+        if classes_local is not None:
+            k_levels = jnp.arange(
+                self.pop.num_classes, dtype=cls_sampled.dtype
+            )
+            pop_hist = jnp.sum(
+                live[:, None]
+                * (cls_sampled[:, None] == k_levels[None, :]).astype(
+                    jnp.float32
+                ),
+                axis=0,
+            )
 
         # --- the round's ONE cross-worker collective: partial update sums,
         # wire accounting, live/failure counts, all in a single psum tuple
         if self.W > 1:
-            upd_sum, wire4, nlive, nfail = jax.lax.psum(
-                (upd_sum, wire4, nlive, nfail), self.axis
-            )
+            if pop_hist is not None:
+                upd_sum, wire4, nlive, nfail, pop_hist = jax.lax.psum(
+                    (upd_sum, wire4, nlive, nfail, pop_hist), self.axis
+                )
+            else:
+                upd_sum, wire4, nlive, nfail = jax.lax.psum(
+                    (upd_sum, wire4, nlive, nfail), self.axis
+                )
         denom = jnp.maximum(nlive, 1.0)
         new_params = jax.tree_util.tree_map(
             lambda w, s: w + fed.server_lr * (s / denom), params, upd_sum
@@ -665,32 +762,67 @@ class FedSim:
             "downlink_bytes": wire_s2c.total_bits / 8.0,
             "rel_volume": wire.rel_volume(),
         }
+        if pop_hist is not None:
+            metrics["pop_hist"] = pop_hist
         if acc is not None:
-            acc = acc.accumulate(
-                wire,
-                live_workers=nlive,
-                dropped_steps=jnp.asarray(nlive < C, jnp.float32),
-                checksum_failures=nfail,
-            )
+            if pop_hist is not None:
+                acc = acc.accumulate(
+                    wire,
+                    live_workers=nlive,
+                    dropped_steps=jnp.asarray(nlive < C, jnp.float32),
+                    checksum_failures=nfail,
+                    pop_hist=pop_hist,
+                )
+            else:
+                acc = acc.accumulate(
+                    wire,
+                    live_workers=nlive,
+                    dropped_steps=jnp.asarray(nlive < C, jnp.float32),
+                    checksum_failures=nfail,
+                )
         return new_params, w_ref, bank, acc, rnd + 1, metrics
 
     def _build(self, params):
+        pop = self.pop is not None
         if self.mesh is None:
-            def fn(params, w_ref, bank, acc, rnd, key):
-                return self._round_body(params, w_ref, bank, acc, rnd, key, 0)
+            if pop:
+                def fn(params, w_ref, bank, acc, rnd, key, classes):
+                    return self._round_body(
+                        params, w_ref, bank, acc, rnd, key, 0,
+                        classes_local=classes,
+                    )
+            else:
+                def fn(params, w_ref, bank, acc, rnd, key):
+                    return self._round_body(
+                        params, w_ref, bank, acc, rnd, key, 0
+                    )
 
             return jax.jit(fn)
 
         axis = self.axis
 
-        def spmd(params, w_ref, bank, acc, rnd, key):
-            widx = jax.lax.axis_index(axis)
-            return self._round_body(params, w_ref, bank, acc, rnd, key, widx)
+        if pop:
+            # the class-id vector shards with the residual bank (same
+            # stratum ownership); it is carried host-side, never returned
+            def spmd(params, w_ref, bank, acc, rnd, key, classes):
+                widx = jax.lax.axis_index(axis)
+                return self._round_body(
+                    params, w_ref, bank, acc, rnd, key, widx,
+                    classes_local=classes,
+                )
+
+            in_specs = (P(), P(), P(axis), P(), P(), P(), P(axis))
+        else:
+            def spmd(params, w_ref, bank, acc, rnd, key):
+                widx = jax.lax.axis_index(axis)
+                return self._round_body(params, w_ref, bank, acc, rnd, key, widx)
+
+            in_specs = (P(), P(), P(axis), P(), P(), P())
 
         fn = shard_map(
             spmd,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(axis), P(), P(), P()),
+            in_specs=in_specs,
             out_specs=(P(), P(), P(axis), P(), P(), P()),
             check_rep=False,
         )
@@ -705,7 +837,7 @@ class FedSim:
 
     def _async_round_body(
         self, params, w_ref, bank, acc, rnd, key, buf, widx,
-        *, alpha=None, latency_row=None, cohort=None,
+        *, alpha=None, latency_row=None, cohort=None, classes_local=None,
     ):
         fed = self.fed
         C = fed.clients_per_round
@@ -752,9 +884,18 @@ class FedSim:
         )
         gids = widx * n_local + ids_local
         positions = jnp.uint32(widx * C_local) + jnp.arange(C_local, dtype=jnp.uint32)
-        batches = jax.vmap(
-            lambda g: self.data_fn(g, rnd, jax.random.fold_in(key_data, g))
-        )(gids)
+        cls_sampled = None
+        if classes_local is not None:
+            cls_sampled = classes_local[ids_local]
+            batches = jax.vmap(
+                lambda g, c: self.pop_data_fn(
+                    g, c, rnd, jax.random.fold_in(key_data, g)
+                )
+            )(gids, cls_sampled)
+        else:
+            batches = jax.vmap(
+                lambda g: self.data_fn(g, rnd, jax.random.fold_in(key_data, g))
+            )(gids)
         res_stack = (
             jax.tree_util.tree_map(lambda r: r[ids_local], bank)
             if self.use_res
@@ -784,8 +925,36 @@ class FedSim:
 
         # --- per-client staleness over GLOBAL cohort positions from the
         # shared tick key (replicated on every worker — no collective),
-        # exactly the FaultPlan-churn trick
-        taus = draw_latency(key, probs, C)
+        # exactly the FaultPlan-churn trick. With per-CLASS latency rows
+        # engaged the draw is worker-LOCAL instead (an inverse-CDF gather
+        # by the sampled class ids, from the same `_LATENCY_TAG` uniform
+        # stream) and scattered into the full-C vector at this worker's
+        # own positions — the only ones `make_async_client_step` reads
+        # (taus[pos]); the transmit-side staleness stats below come from
+        # a psum'd histogram instead of the replicated vector.
+        pop_rows = (
+            self.pop_latency_rows if classes_local is not None else None
+        )
+        if pop_rows is not None:
+            rows_t = jnp.asarray(pop_rows, jnp.float32)  # [K, D]
+            u = jax.random.uniform(
+                jax.random.fold_in(key, _LATENCY_TAG), (C,)
+            )
+            u_local = jax.lax.dynamic_slice(
+                u, (widx * C_local,), (C_local,)
+            )
+            cdf_local = jnp.cumsum(rows_t, axis=1)[cls_sampled]  # [C_local, D]
+            tau_local = jnp.sum(
+                (u_local[:, None] > cdf_local[:, :-1]).astype(jnp.int32),
+                axis=1,
+            )
+            taus = jax.lax.dynamic_update_slice(
+                jnp.zeros((C,), tau_local.dtype),
+                tau_local,
+                (widx * C_local,),
+            )
+        else:
+            taus = draw_latency(key, probs, C)
 
         client_step = make_async_client_step(
             self.tc_c2s,
@@ -832,22 +1001,82 @@ class FedSim:
             * (taus_local[:, None] == levels[None, :]).astype(jnp.float32),
             axis=0,
         )
+        # exact per-class participation histogram of ACCEPTED contributions
+        # (f32[K], the sync round's new member — see _round_body)
+        pop_hist = None
+        if classes_local is not None:
+            k_levels = jnp.arange(
+                self.pop.num_classes, dtype=cls_sampled.dtype
+            )
+            pop_hist = jnp.sum(
+                live[:, None]
+                * (cls_sampled[:, None] == k_levels[None, :]).astype(
+                    jnp.float32
+                ),
+                axis=0,
+            )
+        # per-class latency path: the transmit-side staleness histogram,
+        # f32[D] over TRANSMITTING clients (churn-gated, NOT checksum-gated
+        # — a checksum-failed contribution still arrived with its
+        # staleness). taus is only locally correct here, so the global
+        # st_mean/st_max bookkeeping below derives exactly from this
+        # histogram once psum'd — still ONE collective for the tick.
+        tx_hist = None
+        if pop_rows is not None:
+            m_local = (
+                part_local
+                if part_local is not None
+                else jnp.ones((C_local,), jnp.float32)
+            )
+            tx_hist = jnp.sum(
+                m_local[:, None]
+                * (taus_local[:, None] == levels[None, :]).astype(
+                    jnp.float32
+                ),
+                axis=0,
+            )
 
         # --- the tick's ONE cross-worker collective (the fedsim:async-round
         # audit spec pins it): partial weighted update sums, wire bits,
         # live/failure counts, the weighted live mass and the staleness
-        # histogram, one psum tuple
+        # histogram, one psum tuple — grown by the per-class participation
+        # histogram (and, under per-class latency, the transmit histogram)
+        # when the population plane is engaged
         if self.W > 1:
-            upd_sum, wire4, nlive, nfail, wsum, st_hist = jax.lax.psum(
-                (upd_sum, wire4, nlive, nfail, wsum, st_hist), self.axis
-            )
+            if pop_hist is not None and tx_hist is not None:
+                (upd_sum, wire4, nlive, nfail, wsum, st_hist, pop_hist,
+                 tx_hist) = jax.lax.psum(
+                    (upd_sum, wire4, nlive, nfail, wsum, st_hist, pop_hist,
+                     tx_hist),
+                    self.axis,
+                )
+            elif pop_hist is not None:
+                (upd_sum, wire4, nlive, nfail, wsum, st_hist,
+                 pop_hist) = jax.lax.psum(
+                    (upd_sum, wire4, nlive, nfail, wsum, st_hist, pop_hist),
+                    self.axis,
+                )
+            else:
+                upd_sum, wire4, nlive, nfail, wsum, st_hist = jax.lax.psum(
+                    (upd_sum, wire4, nlive, nfail, wsum, st_hist), self.axis
+                )
 
         # --- staleness bookkeeping over TRANSMITTING clients (a
         # checksum-failed contribution still arrived, with its staleness);
         # churn and taus are both replicated draws over global positions,
         # so these stats need no collective
         taus_f = taus.astype(jnp.float32)
-        if coh_global is not None:
+        if tx_hist is not None:
+            # per-class latency: the replicated-taus trick does not hold
+            # (each worker drew only its own stratum), so the transmit
+            # stats come EXACTLY from the globally-summed histogram
+            levels_f = levels.astype(jnp.float32)
+            sent_global = jnp.sum(tx_hist)
+            st_sum = jnp.sum(levels_f * tx_hist)
+            st_max = jnp.maximum(
+                jnp.max(jnp.where(tx_hist > 0, levels_f, -1.0)), 0.0
+            )
+        elif coh_global is not None:
             # cohort-gated transmitters: compose the gate with churn (the
             # cohort branch is staged only when fed_mt_cohort is set, so
             # the default trace below stays byte-identical)
@@ -920,14 +1149,26 @@ class FedSim:
             "applied": applied,
             "version": new_buf.version.astype(jnp.float32),
         }
+        if pop_hist is not None:
+            metrics["pop_hist"] = pop_hist
         if acc is not None:
-            acc = acc.accumulate(
-                wire,
-                live_workers=nlive,
-                dropped_steps=jnp.asarray(nlive < C, jnp.float32),
-                checksum_failures=nfail,
-                staleness_hist=st_hist,
-            )
+            if pop_hist is not None:
+                acc = acc.accumulate(
+                    wire,
+                    live_workers=nlive,
+                    dropped_steps=jnp.asarray(nlive < C, jnp.float32),
+                    checksum_failures=nfail,
+                    staleness_hist=st_hist,
+                    pop_hist=pop_hist,
+                )
+            else:
+                acc = acc.accumulate(
+                    wire,
+                    live_workers=nlive,
+                    dropped_steps=jnp.asarray(nlive < C, jnp.float32),
+                    checksum_failures=nfail,
+                    staleness_hist=st_hist,
+                )
         return new_params, w_ref, bank, acc, rnd + 1, metrics, new_buf
 
     def _build_async(self, params):
@@ -936,25 +1177,49 @@ class FedSim:
         # the [num_clients, ...] bank is the dominant fixed cost per round
         # at population scale, and the async tick is explicitly a stream —
         # state flows forward, nothing rereads the old tick's arrays
+        pop = self.pop is not None
         if self.mesh is None:
-
-            def fn(params, w_ref, bank, acc, rnd, key, buf):
-                return self._async_round_body(
-                    params, w_ref, bank, acc, rnd, key, buf, 0
-                )
+            if pop:
+                # the class-id vector is a trailing NON-donated operand
+                # (index 7 — donate_argnums stays (0, 1, 2, 6)): it is
+                # static host-carried state reread every tick
+                def fn(params, w_ref, bank, acc, rnd, key, buf, classes):
+                    return self._async_round_body(
+                        params, w_ref, bank, acc, rnd, key, buf, 0,
+                        classes_local=classes,
+                    )
+            else:
+                def fn(params, w_ref, bank, acc, rnd, key, buf):
+                    return self._async_round_body(
+                        params, w_ref, bank, acc, rnd, key, buf, 0
+                    )
 
             return jax.jit(fn, donate_argnums=(0, 1, 2, 6))
 
         axis = self.axis
 
-        def spmd(params, w_ref, bank, acc, rnd, key, buf):
-            widx = jax.lax.axis_index(axis)
-            return self._async_round_body(params, w_ref, bank, acc, rnd, key, buf, widx)
+        if pop:
+            def spmd(params, w_ref, bank, acc, rnd, key, buf, classes):
+                widx = jax.lax.axis_index(axis)
+                return self._async_round_body(
+                    params, w_ref, bank, acc, rnd, key, buf, widx,
+                    classes_local=classes,
+                )
+
+            in_specs = (P(), P(), P(axis), P(), P(), P(), P(), P(axis))
+        else:
+            def spmd(params, w_ref, bank, acc, rnd, key, buf):
+                widx = jax.lax.axis_index(axis)
+                return self._async_round_body(
+                    params, w_ref, bank, acc, rnd, key, buf, widx
+                )
+
+            in_specs = (P(), P(), P(axis), P(), P(), P(), P())
 
         fn = shard_map(
             spmd,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(axis), P(), P(), P(), P()),
+            in_specs=in_specs,
             out_specs=(P(), P(), P(axis), P(), P(), P(), P()),
             check_rep=False,
         )
@@ -1117,30 +1382,35 @@ class FedSim:
                 ),
                 metrics,
             )
+        # the class-id vector is static host-carried state: appended as a
+        # trailing operand when the population plane is on, carried through
+        # to the new state untouched
+        extra = (state.classes,) if self.pop is not None else ()
         if state.buffer is not None:
             with spans.span("fedsim/tick"):
                 params, w_ref, bank, acc, rnd, metrics, buf = self._round(
                     state.params, state.w_ref, state.residuals, state.telemetry,
-                    state.round, key, state.buffer,
+                    state.round, key, state.buffer, *extra,
                 )
             jax.block_until_ready(params)
             self._round_times.append(time.perf_counter() - t0)
             return (
                 FedSimState(
                     params=params, w_ref=w_ref, residuals=bank, round=rnd,
-                    telemetry=acc, buffer=buf,
+                    telemetry=acc, buffer=buf, classes=state.classes,
                 ),
                 metrics,
             )
         with spans.span("fedsim/round"):
             params, w_ref, bank, acc, rnd, metrics = self._round(
                 state.params, state.w_ref, state.residuals, state.telemetry,
-                state.round, key,
+                state.round, key, *extra,
             )
         jax.block_until_ready(params)
         self._round_times.append(time.perf_counter() - t0)
         new_state = FedSimState(
-            params=params, w_ref=w_ref, residuals=bank, round=rnd, telemetry=acc
+            params=params, w_ref=w_ref, residuals=bank, round=rnd,
+            telemetry=acc, classes=state.classes,
         )
         return new_state, metrics
 
@@ -1186,13 +1456,17 @@ class FedSim:
                         tick=tick,
                     )
                 else:
+                    extra = (
+                        (state.classes,) if self.pop is not None else ()
+                    )
                     params, w_ref, bank, acc, rnd, m, buf = self._round(
                         state.params, state.w_ref, state.residuals,
                         state.telemetry, state.round, tick_key, state.buffer,
+                        *extra,
                     )
                     state = FedSimState(
                         params=params, w_ref=w_ref, residuals=bank, round=rnd,
-                        telemetry=acc, buffer=buf,
+                        telemetry=acc, buffer=buf, classes=state.classes,
                     )
                 metrics_hist.append(m)
             jax.block_until_ready(state.params)
@@ -1214,6 +1488,8 @@ class FedSim:
         if mt:
             out["fed_tenants"] = float(self.tenants)
             out["active_tenants"] = float(jnp.sum(state.active))
+        if self.pop is not None:
+            out["pop_classes"] = float(self.pop.num_classes)
         times = self._round_times
         if len(times) > 1:
             times = times[1:]

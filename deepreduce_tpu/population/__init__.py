@@ -1,0 +1,32 @@
+"""Heterogeneous non-IID client populations for the federated serving path.
+
+`PopulationSpec` (spec.py) is the schema-validated, version-tagged class
+table — every client in the `[num_clients, ...]` residual bank belongs to
+one class carrying three heterogeneity axes: data skew (a Dirichlet
+label-concentration per class driving the in-trace non-IID synthetic-data
+generator), a latency class (per-class staleness distribution for the
+async tick), and a compute class (a local-step multiplier priced by
+`costmodel`). sampler.py derives everything at trace/device level from
+the spec's seed alone — class assignments, per-client label mixtures,
+and the batch transform — so no host data ever materializes and the same
+(spec, key) reproduces bitwise anywhere.
+"""
+
+from deepreduce_tpu.population.spec import ClassSpec, PopulationSpec
+from deepreduce_tpu.population.sampler import (
+    class_assignments,
+    concentration_table,
+    label_means,
+    label_mixtures,
+    make_population_data_fn,
+)
+
+__all__ = [
+    "ClassSpec",
+    "PopulationSpec",
+    "class_assignments",
+    "concentration_table",
+    "label_means",
+    "label_mixtures",
+    "make_population_data_fn",
+]

@@ -1,0 +1,192 @@
+"""Deterministic population sampling: everything derives from the spec.
+
+Three PRNG domains, all rooted at ``PRNGKey(spec.seed)`` (a literal trace
+constant — never the round key), with fold_in tags for domain separation
+exactly like round.py's ``_LATENCY_TAG`` discipline:
+
+- ``_POP_ASSIGN_TAG``: the class-assignment permutation. Class COUNTS are
+  exact largest-remainder quotas of the normalized weights (no sampling
+  noise in the population composition); the permutation only shuffles
+  which client id gets which class, so every worker's stratum holds a
+  representative mix.
+- ``_POP_MIX_TAG``: per-client persistent label mixtures
+  ``pi_g ~ Dirichlet(c_class(g))`` via ``fold_in(fold_in(root, tag), g)``
+  — round-independent, so a client keeps its mixture for life.
+- ``_POP_LABEL_TAG``: per-round per-sample labels, folded from the
+  client's ROUND data key (the one the base generator already consumes),
+  so label draws advance with the round schedule without touching the
+  base generator's stream.
+
+The skew transform is a per-sample mean shift ``mu[label]`` (centered
+over the label universe, scaled by ``label_shift``) applied by an exact
+``jnp.where`` SELECT per class gate — an alpha=0 class's batch is the
+base generator's output BITWISE, and a spec with no skewed class at all
+returns the base ``data_fn`` untouched (zero staged ops).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepreduce_tpu.population.spec import PopulationSpec
+
+# fold_in domain-separation tags (see module docstring)
+_POP_ASSIGN_TAG = 0xA551
+_POP_MIX_TAG = 0x314D
+_POP_LABEL_TAG = 0x1ABE1
+
+
+def class_counts(spec: PopulationSpec, num_clients: int) -> Tuple[int, ...]:
+    """Exact largest-remainder quotas of the normalized class weights —
+    deterministic, sums to num_clients, ties broken by class order."""
+    if num_clients < 1:
+        raise ValueError(f"num_clients must be >= 1, got {num_clients}")
+    raw = [w * num_clients for w in spec.weights]
+    counts = [int(math.floor(r)) for r in raw]
+    rem = num_clients - sum(counts)
+    order = sorted(
+        range(spec.num_classes),
+        key=lambda k: (-(raw[k] - counts[k]), k),
+    )
+    for k in order[:rem]:
+        counts[k] += 1
+    return tuple(counts)
+
+
+def class_assignments(spec: PopulationSpec, num_clients: int) -> jax.Array:
+    """The i32[num_clients] class-id vector: quota-exact composition,
+    spec-seeded permutation. Bitwise reproducible from (spec, N) alone."""
+    counts = class_counts(spec, num_clients)
+    base = np.repeat(
+        np.arange(spec.num_classes, dtype=np.int32), np.asarray(counts)
+    )
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(spec.seed), _POP_ASSIGN_TAG
+    )
+    perm = jax.random.permutation(key, num_clients)
+    return jnp.asarray(base)[perm].astype(jnp.int32)
+
+
+def concentration_table(spec: PopulationSpec) -> np.ndarray:
+    """f32[K, L] Dirichlet concentration rows: ``c[k, l] = data_alpha_k +
+    data_bias_k·[l == k % L]``. An alpha=0 (IID-sentinel) class's row is
+    all zeros — callers must gate it out, or use the mixture helpers
+    below which substitute the uniform mixture for those rows."""
+    K, L = spec.num_classes, spec.num_labels
+    c = np.zeros((K, L), dtype=np.float32)
+    for k, cls in enumerate(spec.classes):
+        c[k, :] = cls.data_alpha
+        if cls.data_bias > 0.0:
+            c[k, k % L] += cls.data_bias
+    return c
+
+
+def expected_marginals(spec: PopulationSpec) -> np.ndarray:
+    """f32[K, L] analytic per-class label marginals ``E[pi | class k] =
+    c_k / sum(c_k)`` (uniform for alpha=0 rows) — what the planted-skew
+    test pins the empirical mixtures against."""
+    c = concentration_table(spec)
+    out = np.full_like(c, 1.0 / spec.num_labels)
+    for k in range(spec.num_classes):
+        s = c[k].sum()
+        if s > 0:
+            out[k] = c[k] / s
+    return out
+
+
+def label_means(spec: PopulationSpec) -> np.ndarray:
+    """f32[L] centered per-label mean shifts spanning
+    [-label_shift, +label_shift] with exact zero mean over the universe."""
+    L = spec.num_labels
+    levels = 2.0 * np.arange(L, dtype=np.float32) - (L - 1)
+    return (spec.label_shift * levels / (L - 1)).astype(np.float32)
+
+
+def label_mixtures(
+    spec: PopulationSpec,
+    client_ids: Sequence[int],
+    classes: Sequence[int],
+) -> jax.Array:
+    """f32[n, L] persistent per-client label mixtures for the given
+    (global client id, class id) pairs — the same fold_in chain the
+    in-trace generator uses, so host-side inspection matches the traced
+    draws bitwise. Alpha=0 classes get the uniform mixture."""
+    conc = jnp.asarray(concentration_table(spec))
+    safe = jnp.where(conc > 0, conc, 1.0)
+    on = jnp.asarray(
+        [1.0 if c.data_alpha > 0.0 else 0.0 for c in spec.classes],
+        jnp.float32,
+    )
+    L = spec.num_labels
+    mix_base = jax.random.fold_in(
+        jax.random.PRNGKey(spec.seed), _POP_MIX_TAG
+    )
+
+    def one(g, k):
+        pi = jax.random.dirichlet(jax.random.fold_in(mix_base, g), safe[k])
+        return jnp.where(on[k] > 0, pi, jnp.full((L,), 1.0 / L))
+
+    return jax.vmap(one)(
+        jnp.asarray(client_ids, jnp.int32), jnp.asarray(classes, jnp.int32)
+    )
+
+
+def make_population_data_fn(
+    spec: PopulationSpec, data_fn: Callable
+) -> Callable:
+    """Wrap a ``data_fn(client_id, rnd, key) -> batch`` into
+    ``pop_data_fn(client_id, class_id, rnd, key) -> batch`` applying the
+    class-conditioned non-IID transform. With no skewed class the base
+    generator is returned untouched (modulo the extra ignored class
+    argument) — zero staged ops, the bitwise-degeneracy anchor.
+
+    The transform assumes the batch's leaves lead with the
+    ``[local_steps, batch]`` sample dims (the `synthetic_linear_problem`
+    shape); leaves with other leading dims pass through unshifted."""
+    if not spec.skew_on:
+        def iid_data_fn(client_id, class_id, rnd, key):
+            return data_fn(client_id, rnd, key)
+
+        return iid_data_fn
+
+    conc = jnp.asarray(concentration_table(spec))
+    safe = jnp.where(conc > 0, conc, 1.0)
+    gates = jnp.asarray(
+        [1.0 if c.data_alpha > 0.0 else 0.0 for c in spec.classes],
+        jnp.float32,
+    )
+    mu = jnp.asarray(label_means(spec))
+    mix_base = jax.random.fold_in(
+        jax.random.PRNGKey(spec.seed), _POP_MIX_TAG
+    )
+
+    def pop_data_fn(client_id, class_id, rnd, key):
+        batch = data_fn(client_id, rnd, key)
+        leaves = jax.tree_util.tree_leaves(batch)
+        lead = leaves[0].shape[: min(2, leaves[0].ndim)]
+        # persistent mixture (client-for-life), per-round labels (from
+        # the round data key's domain-separated sibling)
+        pi = jax.random.dirichlet(
+            jax.random.fold_in(mix_base, client_id), safe[class_id]
+        )
+        labels = jax.random.categorical(
+            jax.random.fold_in(key, _POP_LABEL_TAG), jnp.log(pi),
+            shape=lead,
+        )
+        shift = mu[labels]
+        on = gates[class_id] > 0
+
+        def shifted(v):
+            if v.ndim >= len(lead) and v.shape[: len(lead)] == lead:
+                s = shift.reshape(lead + (1,) * (v.ndim - len(lead)))
+                return jnp.where(on, v + s, v)
+            return v
+
+        return jax.tree_util.tree_map(shifted, batch)
+
+    return pop_data_fn

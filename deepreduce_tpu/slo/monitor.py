@@ -185,6 +185,9 @@ def _normalize_report(report: Dict[str, Any]) -> Dict[str, Any]:
     hist = report.get("staleness_hist")
     if hist is not None and len(hist):
         out["staleness_hist"] = [float(h) for h in hist]
+    pop = report.get("pop_hist")
+    if pop is not None and len(pop):
+        out["pop_hist"] = [float(h) for h in pop]
     return out
 
 
@@ -217,6 +220,21 @@ def _residency(
     if not vals:
         return None
     return sum(1.0 for v in vals if v <= band) / len(vals)
+
+
+def _pop_min_share(rows: Sequence[Dict[str, Any]]) -> Optional[float]:
+    hists = [r["pop_hist"] for r in rows if "pop_hist" in r]
+    if not hists:
+        return None
+    depth = max(len(h) for h in hists)
+    total = [0.0] * depth
+    for h in hists:
+        for k, v in enumerate(h):
+            total[k] += v
+    grand = sum(total)
+    if grand <= 0.0:
+        return None
+    return min(total) / grand
 
 
 def _burn(rows: Sequence[Dict[str, Any]], budget: float) -> float:
@@ -340,6 +358,10 @@ class HealthMonitor:
                 evals.append(graded(
                     key, resid_min, _residency(win, thr),
                     _residency(slow, thr), lambda v: v < resid_min))
+            elif key == "pop_residency_min":
+                evals.append(graded(
+                    key, thr, _pop_min_share(win), _pop_min_share(slow),
+                    lambda v: v < thr))
             # convergence_residency_min is folded into convergence_band
         return evals
 

@@ -42,6 +42,10 @@ TARGET_KEYS: Dict[str, str] = {
     "convergence_residency_min":
         "floor on the fraction of window ticks inside the band "
         "(requires convergence_band; defaults to 1.0 when band is set)",
+    "pop_residency_min":
+        "floor on the smallest per-class participation share over the "
+        "window, from the exact on-device population histogram (rows "
+        "must carry a pop_hist; absent rows do not count)",
 }
 
 _SPEC_KEYS = frozenset({
@@ -74,7 +78,7 @@ def _check_targets(targets: Any, where: str) -> Dict[str, float]:
         val = float(raw)
         if key == "checksum_failure_budget":
             ok = 0.0 < val <= 1.0
-        elif key == "convergence_residency_min":
+        elif key in ("convergence_residency_min", "pop_residency_min"):
             ok = 0.0 <= val <= 1.0
         elif key == "convergence_band":
             ok = val > 0.0

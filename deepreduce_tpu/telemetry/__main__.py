@@ -339,6 +339,24 @@ def _fedsim_report(hist: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         out["fed_staleness_p50"] = hist_quantile(total, 0.50)
         out["fed_staleness_p95"] = hist_quantile(total, 0.95)
         out["fed_staleness_p99"] = hist_quantile(total, 0.99)
+    # heterogeneous populations: exact per-class participation from the
+    # on-device pop_hist psum member (per-round f32[K] rows, logged as
+    # lists) — cumulative counts, shares, and the worst class's share
+    pop_hists = [
+        r["pop_hist"] for r in hist
+        if isinstance(r.get("pop_hist"), list) and r["pop_hist"]
+    ]
+    if pop_hists:
+        K = max(len(h) for h in pop_hists)
+        pop_total = [
+            sum(float(h[k]) for h in pop_hists if k < len(h))
+            for k in range(K)
+        ]
+        grand = max(sum(pop_total), 1.0)
+        out["fed_pop_classes"] = K
+        out["fed_pop_hist_total"] = pop_total
+        out["fed_pop_shares"] = [v / grand for v in pop_total]
+        out["fed_pop_residency_min"] = min(out["fed_pop_shares"])
     fills = [
         float(r["buffer_fill"])
         for r in hist
@@ -511,6 +529,17 @@ def cmd_summary(args) -> int:
             print(
                 "    fed_buffer_fill_per_apply: "
                 f"{fed['fed_buffer_fill_per_apply']:.6g}"
+            )
+        if "fed_pop_classes" in fed:
+            print(f"    fed_pop_classes: {fed['fed_pop_classes']}")
+            shares = ", ".join(f"{v:.6g}" for v in fed["fed_pop_shares"])
+            print(
+                f"    fed_pop_shares: [{shares}]  "
+                "(exact, on-device histogram)"
+            )
+            print(
+                "    fed_pop_residency_min: "
+                f"{fed['fed_pop_residency_min']:.6g}"
             )
         if "fed_tenants" in fed:
             print(f"    fed_tenants: {fed['fed_tenants']}")
@@ -1091,6 +1120,8 @@ def cmd_slo(args) -> int:
                 }
                 if isinstance(r.get("staleness_hist"), list):
                     rep["staleness_hist"] = r["staleness_hist"]
+                if isinstance(r.get("pop_hist"), list):
+                    rep["pop_hist"] = r["pop_hist"]
                 if dt and isinstance(r.get("clients"), (int, float)):
                     rep["clients_per_sec"] = float(r["clients"]) / dt
                     rates.setdefault(0, []).append(rep["clients_per_sec"])
@@ -1436,6 +1467,24 @@ def cmd_trace(args) -> int:
                      "args": {name: float(
                          hist_quantile(rec["staleness_hist"], q)
                      )}}
+                )
+    # per-tick per-class participation shares become counter tracks too
+    # (fed_pop_share_c{k}): the pop_hist rows are lists like the staleness
+    # histograms, so derive each class's share per tick
+    pop_rows = [
+        r for r in hist
+        if "ts" in r and isinstance(r.get("pop_hist"), list)
+        and r["pop_hist"]
+    ]
+    if pop_rows and ts0 is not None:
+        for rec in pop_rows:
+            ts = round((rec["ts"] - ts0) * 1e6, 3)
+            total = max(sum(float(v) for v in rec["pop_hist"]), 1.0)
+            for k, v in enumerate(rec["pop_hist"]):
+                name = f"fed_pop_share_c{k}"
+                events.append(
+                    {"name": name, "ph": "C", "ts": ts, "pid": 1, "tid": 0,
+                     "args": {name: float(v) / total}}
                 )
     # SLO health transitions (health.jsonl) become global instant markers,
     # anchored like ctrl decisions: the records carry no wall clock by

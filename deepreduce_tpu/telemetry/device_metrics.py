@@ -55,6 +55,15 @@ def fetch_delta(cur: Dict[str, Any], prev: Dict[str, Any]) -> Dict[str, Any]:
                 f"{len(cur_s)} vs {len(prev_s)}"
             )
         out["staleness_hist"] = [c - p for c, p in zip(cur_s, prev_s)]
+    cur_p = cur.get("pop_hist", [])
+    prev_p = prev.get("pop_hist", [])
+    if cur_p or prev_p:
+        if len(cur_p) != len(prev_p):
+            raise ValueError(
+                "fetch_delta pop_hist length mismatch: "
+                f"{len(cur_p)} vs {len(prev_p)}"
+            )
+        out["pop_hist"] = [c - p for c, p in zip(cur_p, prev_p)]
     return out
 
 
@@ -123,20 +132,36 @@ class MetricAccumulators:
     # else) — the exact cumulative staleness distribution the SLO health
     # plane derives its p50/p95/p99 tails from
     staleness_hist: jax.Array
+    # Σ per-class ACCEPTED-contribution counts, f32[K] in population class
+    # order for the heterogeneous-population federated drivers. None (not
+    # f32[0]) everywhere else: a None child contributes no pytree leaf, so
+    # every population-free accumulator — and every committed trace hash
+    # downstream of one — is structurally unchanged
+    pop_hist: Optional[jax.Array] = None
 
     @classmethod
-    def zeros(cls, num_buckets: int = 0, num_stale_levels: int = 0) -> "MetricAccumulators":
+    def zeros(
+        cls,
+        num_buckets: int = 0,
+        num_stale_levels: int = 0,
+        num_pop_classes: int = 0,
+    ) -> "MetricAccumulators":
         # one FRESH buffer per field: the accumulator is donated to the jitted
         # step (train.Trainer._build), and donating one shared zeros() buffer
         # for every field is a donate-twice XLA runtime error
         scalars = tuple(
             jnp.zeros((), jnp.float32)
-            for _ in range(len(dataclasses.fields(cls)) - 2)
+            for _ in range(len(dataclasses.fields(cls)) - 3)
         )
         return cls(
             *scalars,
             jnp.zeros((int(num_buckets),), jnp.float32),
             jnp.zeros((int(num_stale_levels),), jnp.float32),
+            (
+                jnp.zeros((int(num_pop_classes),), jnp.float32)
+                if num_pop_classes
+                else None
+            ),
         )
 
     def accumulate(
@@ -158,6 +183,7 @@ class MetricAccumulators:
         rs_oktopk_spills=0.0,
         bucket_saturated=0.0,
         staleness_hist=0.0,
+        pop_hist=0.0,
     ) -> "MetricAccumulators":
         f = lambda x: jnp.asarray(x, jnp.float32)
         return MetricAccumulators(
@@ -185,6 +211,12 @@ class MetricAccumulators:
             # unbucketed — a no-op on the empty vector)
             bucket_saturated=self.bucket_saturated + f(bucket_saturated),
             staleness_hist=self.staleness_hist + f(staleness_hist),
+            # the None/engaged branch is STATIC (population wiring is a
+            # build-time property), so the disengaged accumulate stages the
+            # exact ops it always did
+            pop_hist=(
+                None if self.pop_hist is None else self.pop_hist + f(pop_hist)
+            ),
         )
 
     # ------------------------------------------------------------------ #
@@ -202,12 +234,12 @@ class MetricAccumulators:
     @classmethod
     def scalar_fields(cls) -> Tuple[str, ...]:
         """Field names of the scalar counters, in declaration order
-        (everything except the vector-valued `bucket_saturated` and
-        `staleness_hist`)."""
+        (everything except the vector-valued `bucket_saturated`,
+        `staleness_hist` and `pop_hist`)."""
         return tuple(
             f.name
             for f in dataclasses.fields(cls)
-            if f.name not in ("bucket_saturated", "staleness_hist")
+            if f.name not in ("bucket_saturated", "staleness_hist", "pop_hist")
         )
 
     def fetch(self) -> Dict[str, Any]:
@@ -228,6 +260,11 @@ class MetricAccumulators:
             vals["staleness_hist"] = [
                 float(v)
                 for v in np.asarray(self.staleness_hist, np.float32).reshape(-1)
+            ]
+        if self.pop_hist is not None and self.pop_hist.size:
+            vals["pop_hist"] = [
+                float(v)
+                for v in np.asarray(self.pop_hist, np.float32).reshape(-1)
             ]
         return vals
 
@@ -252,6 +289,16 @@ class MetricAccumulators:
             out["staleness_p50"] = hist_quantile(stale_hist, 0.50)
             out["staleness_p95"] = hist_quantile(stale_hist, 0.95)
             out["staleness_p99"] = hist_quantile(stale_hist, 0.99)
+        pop_hist = vals.get("pop_hist", [])
+        if len(pop_hist):
+            # exact per-class participation from the cumulative on-device
+            # histogram (heterogeneous populations): accepted-contribution
+            # counts, their shares, and the worst class's share — the
+            # residency floor the SLO health plane gates on
+            out["pop_hist"] = [float(v) for v in pop_hist]
+            total = max(sum(float(v) for v in pop_hist), 1.0)
+            out["pop_shares"] = [float(v) / total for v in pop_hist]
+            out["pop_residency_min"] = min(out["pop_shares"])
         return out | {
             "steps": vals["steps"],
             "cumulative_total_bits": vals["index_bits"] + vals["value_bits"],

@@ -700,7 +700,8 @@ def test_trace_fingerprint_strips_host_side_knobs():
 
     base = dict(communicator="allgather", decode="loop", buckets="off",
                 stream="off", rs_mode="sparse", hier="off", resilience="off",
-                ctrl="off", fed="off", fed_async="off", fed_mt="off")
+                ctrl="off", fed="off", fed_async="off", fed_mt="off",
+                population="off")
     on = dict(base, ctrl="on")
     fp_off = lattice.trace_fingerprint(lattice.cell_kwargs(base), "flat")
     fp_on = lattice.trace_fingerprint(lattice.cell_kwargs(on), "flat")

@@ -1,0 +1,578 @@
+"""Heterogeneous population plane (deepreduce_tpu.population): spec schema
+and reason codes, the config fences, the deterministic sampler (quota-exact
+assignments, planted-skew marginals), the shared latency-row parser family,
+bitwise IID degeneracy of the uniform spec (sync AND async — params,
+residual bank, buffer), the exact per-class participation histogram riding
+the one fused psum, the accumulator/costmodel/SLO plumbing, and the
+committed BENCH_POP_r25 ledger row."""
+
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepreduce_tpu import costmodel as cm
+from deepreduce_tpu.config import ConfigError, DeepReduceConfig, reason_code_of
+from deepreduce_tpu.fedsim import FedSim, parse_latency, synthetic_linear_problem
+from deepreduce_tpu.fedsim.round import parse_class_latency, parse_tenant_latency
+from deepreduce_tpu.population import (
+    ClassSpec,
+    PopulationSpec,
+    class_assignments,
+    label_mixtures,
+    make_population_data_fn,
+)
+from deepreduce_tpu.population.sampler import (
+    class_counts,
+    concentration_table,
+    expected_marginals,
+    label_means,
+)
+
+DIM, BATCH, LOCAL = 16, 4, 2
+
+UNIFORM_SPEC = '{"version": 1, "classes": [{"name": "uniform"}]}'
+SKEW_SPEC = json.dumps({
+    "version": 1,
+    "num_labels": 4,
+    "label_shift": 0.05,
+    "classes": [
+        {"name": "bulk", "weight": 3.0, "data_alpha": 2.0},
+        {"name": "skewed", "weight": 1.0, "data_alpha": 0.5, "data_bias": 4.0},
+    ],
+})
+
+
+def _cfg(**kw):
+    base = dict(
+        deepreduce="index",
+        index="bloom",
+        bloom_blocked="mod",
+        compress_ratio=0.25,
+        fpr=0.01,
+        memory="residual",
+        min_compress_size=8,
+    )
+    base.update(kw)
+    return DeepReduceConfig(**base)
+
+
+def _fed_kw(**kw):
+    base = dict(fed=True, fed_num_clients=64, fed_clients_per_round=16,
+                fed_local_steps=LOCAL)
+    base.update(kw)
+    return base
+
+
+def _driver(cfg, mesh, chunk=2):
+    params0, data_fn, loss_fn = synthetic_linear_problem(DIM, BATCH, LOCAL)
+    fs = FedSim(loss_fn, cfg, cfg.fed_config(), optax.sgd(0.1), data_fn,
+                mesh=mesh, client_chunk=chunk)
+    return fs, fs.init(params0)
+
+
+def _leaves_equal(a, b):
+    return all(
+        bool(jnp.all(x == y))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )
+
+
+# ---------------------------------------------------------------------- #
+# spec schema: parse, views, roundtrip
+# ---------------------------------------------------------------------- #
+
+
+def test_spec_roundtrip_and_views():
+    spec = PopulationSpec.load_any(SKEW_SPEC)
+    assert spec.num_classes == 2 and spec.num_labels == 4
+    assert spec.weights == pytest.approx((0.75, 0.25))
+    assert spec.skew_on and not spec.latency_on and not spec.is_uniform
+    # to_dict -> from_dict is the identity on the parsed form
+    assert PopulationSpec.from_dict(spec.to_dict()) == spec
+
+    uni = PopulationSpec.uniform()
+    assert uni.is_uniform and uni.num_classes == 1
+    assert uni.weights == (1.0,) and uni.local_steps_mults == (1.0,)
+    assert not uni.skew_on and not uni.latency_on
+    # the config-knob override replaces only the label universe
+    assert uni.with_overrides(num_labels=16).num_labels == 16
+    assert uni.with_overrides(num_labels=0) == uni
+
+    lat = PopulationSpec(classes=(
+        ClassSpec(name="fast", latency="0.6,0.3,0.1"),
+        ClassSpec(name="slow"),
+    ))
+    assert lat.latency_on and not lat.is_uniform
+
+
+def test_spec_load_paths(tmp_path):
+    p = tmp_path / "pop.json"
+    p.write_text(SKEW_SPEC)
+    assert PopulationSpec.load(p) == PopulationSpec.load_any(SKEW_SPEC)
+    assert PopulationSpec.load_any(str(p)) == PopulationSpec.load_any(SKEW_SPEC)
+
+    with pytest.raises(ConfigError) as ei:
+        PopulationSpec.load(tmp_path / "missing.json")
+    assert reason_code_of(ei.value) == "pop-spec-syntax"
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(ConfigError) as ei:
+        PopulationSpec.load(bad)
+    assert reason_code_of(ei.value) == "pop-spec-syntax"
+    with pytest.raises(ConfigError) as ei:
+        PopulationSpec.load_any("")
+    assert reason_code_of(ei.value) == "pop-spec-syntax"
+    with pytest.raises(ConfigError) as ei:
+        PopulationSpec.load_any("{not json")
+    assert reason_code_of(ei.value) == "pop-spec-syntax"
+
+
+def _cls(**kw):
+    base = {"name": "c0"}
+    base.update(kw)
+    return base
+
+
+@pytest.mark.parametrize("raw, code", [
+    (["not", "an", "object"], "pop-spec-syntax"),
+    ({"bogus_key": 1, "classes": [_cls()]}, "pop-spec-syntax"),
+    ({"version": 2, "classes": [_cls()]}, "pop-spec-syntax"),
+    ({"classes": "nope"}, "pop-spec-syntax"),
+    ({"classes": ["nope"]}, "pop-spec-syntax"),
+    ({"classes": [{"weight": 1.0}]}, "pop-spec-syntax"),       # missing name
+    ({"classes": [_cls(bogus=1)]}, "pop-spec-syntax"),
+    ({"classes": [_cls(weight="3")]}, "pop-spec-syntax"),
+    ({"classes": [_cls(), _cls()]}, "pop-spec-syntax"),        # duplicate name
+    ({"classes": []}, "pop-spec-range"),
+    ({"classes": [{"name": f"c{i}"} for i in range(65)]}, "pop-spec-range"),
+    ({"classes": [_cls(weight=0.0)]}, "pop-spec-range"),
+    ({"classes": [_cls(data_alpha=-0.5)]}, "pop-spec-range"),
+    ({"classes": [_cls(data_bias=-1.0)]}, "pop-spec-range"),
+    # bias on the IID sentinel: there is no Dirichlet to bias
+    ({"classes": [_cls(data_bias=2.0)]}, "pop-spec-range"),
+    ({"classes": [_cls(local_steps_mult=0.5)]}, "pop-spec-range"),
+    ({"classes": [_cls(latency=7)]}, "pop-spec-syntax"),
+    ({"classes": [_cls(latency="0.5,x")]}, "pop-latency-syntax"),
+    ({"classes": [_cls()], "num_labels": 1}, "pop-labels-range"),
+    ({"classes": [_cls()], "num_labels": "many"}, "pop-labels-range"),
+    ({"classes": [_cls()], "label_shift": -0.1}, "pop-spec-range"),
+    ({"classes": [_cls()], "seed": -1}, "pop-spec-range"),
+])
+def test_spec_rejections(raw, code):
+    with pytest.raises(ConfigError) as ei:
+        PopulationSpec.from_dict(raw)
+    assert reason_code_of(ei.value) == code
+
+
+# ---------------------------------------------------------------------- #
+# config fences
+# ---------------------------------------------------------------------- #
+
+
+def test_config_population_fences():
+    # pop_spec without the federated geometry: nothing to classify
+    with pytest.raises(ConfigError) as ei:
+        _cfg(pop_spec=UNIFORM_SPEC)
+    assert reason_code_of(ei.value) == "pop-needs-fed"
+    # engaged override knob without its consumer
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(pop_labels=4))
+    assert reason_code_of(ei.value) == "pop-knobs-disengaged"
+    # per-class and per-tenant heterogeneity do not compose
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(pop_spec=UNIFORM_SPEC, fed_tenants=2))
+    assert reason_code_of(ei.value) == "pop-vs-mt"
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(pop_spec=UNIFORM_SPEC, pop_labels=1))
+    assert reason_code_of(ei.value) == "pop-labels-range"
+    # per-class latency rows configure the async staleness draw only
+    lat_spec = json.dumps({"version": 1, "classes": [
+        {"name": "slow", "latency": "0.5,0.5"}]})
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(pop_spec=lat_spec))
+    assert reason_code_of(ei.value) == "pop-knobs-disengaged"
+    # a typo'd spec fails at config construction, not driver build
+    with pytest.raises(ConfigError) as ei:
+        _cfg(**_fed_kw(pop_spec='{"classes": [{"name": "a", "weight": 0}]}'))
+    assert reason_code_of(ei.value) == "pop-spec-range"
+    # valid engagements construct: sync skew, async per-class latency
+    cfg = _cfg(**_fed_kw(pop_spec=SKEW_SPEC, pop_labels=8))
+    assert cfg.pop_spec == SKEW_SPEC and cfg.pop_labels == 8
+    cfg = _cfg(**_fed_kw(fed_async=True, fed_async_k=16, pop_spec=lat_spec))
+    assert cfg.fed_async
+
+
+# ---------------------------------------------------------------------- #
+# shared latency-row parser family (r25 hardening)
+# ---------------------------------------------------------------------- #
+
+
+def test_parse_latency_rejects_non_finite_and_labels_knob():
+    with pytest.raises(ValueError, match="finite"):
+        parse_latency("inf,1")
+    with pytest.raises(ValueError, match="finite"):
+        parse_latency("nan")
+    # the name kwarg labels the failing knob in the message
+    with pytest.raises(ValueError, match="my_knob"):
+        parse_latency("0.5,x", name="my_knob")
+
+
+def test_parse_tenant_latency_rejects_empty_row():
+    with pytest.raises(ValueError, match="empty per-tenant row"):
+        parse_tenant_latency("0.5,0.5;;1", 3, "")
+    # '' broadcasts the default to the fleet
+    assert parse_tenant_latency("", 2, "0.5,0.5") == ((0.5, 0.5), (0.5, 0.5))
+
+
+def test_parse_class_latency_inheritance_and_padding():
+    # '' inherits the global default; rows zero-pad to the common depth D
+    rows = parse_class_latency(["", "0.5,0.3,0.2"], default="1")
+    assert rows == ((1.0, 0.0, 0.0), (0.5, 0.3, 0.2))
+    # padding is draw-preserving: no probability mass lands on the tail
+    assert all(sum(r) == pytest.approx(1.0) for r in rows)
+    # no default and no overrides: everyone on the zero-latency row
+    assert parse_class_latency(["", ""]) == ((1.0,), (1.0,))
+    with pytest.raises(ValueError, match=r"class\[1\]"):
+        parse_class_latency(["", "0.5,x"])
+
+
+# ---------------------------------------------------------------------- #
+# sampler determinism: quotas, assignments, mixtures, planted skew
+# ---------------------------------------------------------------------- #
+
+
+def test_class_counts_largest_remainder():
+    spec = PopulationSpec.load_any(SKEW_SPEC)
+    assert class_counts(spec, 64) == (48, 16)          # exact quotas
+    assert class_counts(spec, 10) == (8, 2)            # tie -> class order
+    assert sum(class_counts(spec, 7)) == 7             # always sums to N
+    with pytest.raises(ValueError, match=">= 1"):
+        class_counts(spec, 0)
+
+
+def test_class_assignments_deterministic():
+    spec = PopulationSpec.load_any(SKEW_SPEC)
+    a1 = np.asarray(class_assignments(spec, 64))
+    a2 = np.asarray(class_assignments(spec, 64))
+    np.testing.assert_array_equal(a1, a2)              # bitwise from (spec, N)
+    assert a1.dtype == np.int32
+    # quota-exact composition survives the permutation
+    assert np.bincount(a1, minlength=2).tolist() == [48, 16]
+    # the permutation is spec-seeded: a different seed reshuffles
+    reseeded = PopulationSpec.from_dict(
+        json.loads(SKEW_SPEC) | {"seed": 7})
+    a3 = np.asarray(class_assignments(reseeded, 64))
+    assert np.bincount(a3, minlength=2).tolist() == [48, 16]
+    assert np.any(a1 != a3)
+
+
+def test_planted_skew_marginals_analytic():
+    spec = PopulationSpec.load_any(SKEW_SPEC)
+    c = concentration_table(spec)
+    # c[k, l] = data_alpha_k + data_bias_k * [l == k % L]
+    np.testing.assert_allclose(c[0], [2.0, 2.0, 2.0, 2.0])
+    np.testing.assert_allclose(c[1], [0.5, 4.5, 0.5, 0.5])
+    m = expected_marginals(spec)
+    np.testing.assert_allclose(m[0], [0.25] * 4)
+    np.testing.assert_allclose(m[1], c[1] / c[1].sum())
+    # an alpha=0 (IID sentinel) class gets the uniform marginal
+    iid = PopulationSpec.uniform(num_labels=4)
+    np.testing.assert_allclose(expected_marginals(iid), [[0.25] * 4])
+    # label means: centered over the universe, spanning +-label_shift
+    mu = label_means(spec)
+    assert float(mu.sum()) == pytest.approx(0.0, abs=1e-7)
+    assert float(mu.min()) == pytest.approx(-spec.label_shift)
+    assert float(mu.max()) == pytest.approx(spec.label_shift)
+
+
+def test_label_mixtures_deterministic_and_match_marginals():
+    spec = PopulationSpec.load_any(SKEW_SPEC)
+    ids = list(range(256))
+    m1 = np.asarray(label_mixtures(spec, ids, [1] * 256))
+    m2 = np.asarray(label_mixtures(spec, ids, [1] * 256))
+    np.testing.assert_array_equal(m1, m2)              # bitwise across calls
+    np.testing.assert_allclose(m1.sum(axis=1), 1.0, atol=1e-5)
+    # empirical mean over many clients approaches the analytic marginal
+    np.testing.assert_allclose(
+        m1.mean(axis=0), expected_marginals(spec)[1], atol=0.05)
+    # alpha=0 classes get the exact uniform mixture, not a degenerate draw
+    iid = PopulationSpec(classes=(
+        ClassSpec(name="iid"), ClassSpec(name="skew", data_alpha=1.0)),
+        num_labels=4)
+    rows = np.asarray(label_mixtures(iid, [0, 1], [0, 0]))
+    np.testing.assert_array_equal(rows, np.full((2, 4), 0.25))
+
+
+def test_pop_data_fn_gates_are_exact_selects():
+    _, data_fn, _ = synthetic_linear_problem(DIM, BATCH, LOCAL)
+    key = jax.random.PRNGKey(5)
+    # no skewed class: the base generator comes back untouched
+    uni = PopulationSpec.uniform()
+    uni_fn = make_population_data_fn(uni, data_fn)
+    assert _leaves_equal(uni_fn(3, 0, 2, key), data_fn(3, 2, key))
+    # skewed spec: an alpha=0 class's batch is the base output BITWISE
+    # (jnp.where SELECT, never a mask-multiply); the skewed class shifts
+    mixed = PopulationSpec(classes=(
+        ClassSpec(name="iid"),
+        ClassSpec(name="skew", data_alpha=0.3, data_bias=3.0)),
+        num_labels=4, label_shift=0.5)
+    pop_fn = make_population_data_fn(mixed, data_fn)
+    assert _leaves_equal(pop_fn(3, 0, 2, key), data_fn(3, 2, key))
+    assert not _leaves_equal(pop_fn(3, 1, 2, key), data_fn(3, 2, key))
+
+
+# ---------------------------------------------------------------------- #
+# driver degeneracy: the uniform spec IS the IID program, bitwise
+# ---------------------------------------------------------------------- #
+
+
+def test_uniform_spec_bitwise_degenerate_sync(mesh8):
+    """A single-class uniform spec changes the wire (the f32[K=1] histogram
+    rides the fused psum) but not the math: params AND residual bank land
+    bitwise on the population-free round's."""
+    key = jax.random.PRNGKey(0)
+    fs_i, st_i = _driver(_cfg(**_fed_kw()), mesh8)
+    m_i = None
+    for r in range(3):
+        st_i, m_i = fs_i.step(st_i, jax.random.fold_in(key, r))
+
+    fs_p, st_p = _driver(_cfg(**_fed_kw(pop_spec=UNIFORM_SPEC)), mesh8)
+    assert st_p.classes is not None and st_p.classes.shape == (64,)
+    m_p = None
+    for r in range(3):
+        st_p, m_p = fs_p.step(st_p, jax.random.fold_in(key, r))
+    assert _leaves_equal(st_i.params, st_p.params)
+    assert _leaves_equal(st_i.residuals, st_p.residuals)
+    # the exact histogram accounts for every sampled client, every round
+    assert "pop_hist" not in m_i
+    h = np.asarray(m_p["pop_hist"])
+    assert h.shape == (1,) and float(h[0]) == float(m_p["clients"])
+
+
+def test_uniform_spec_bitwise_degenerate_async(mesh8):
+    """Same contract on the buffered-async tick: params, residual bank,
+    AND the aggregation buffer are bitwise, with the staleness draw and
+    buffer cadence untouched by the riding histogram."""
+    kw = dict(fed_async=True, fed_async_k=40, fed_async_alpha=0.5,
+              fed_async_latency="0.5,0.3,0.2")
+    key = jax.random.PRNGKey(0)
+    fs_i, st_i = _driver(_cfg(**_fed_kw(**kw)), mesh8)
+    for r in range(4):
+        st_i, _ = fs_i.step(st_i, jax.random.fold_in(key, r))
+
+    fs_p, st_p = _driver(_cfg(**_fed_kw(pop_spec=UNIFORM_SPEC, **kw)), mesh8)
+    m_p = None
+    for r in range(4):
+        st_p, m_p = fs_p.step(st_p, jax.random.fold_in(key, r))
+    assert _leaves_equal(st_i.params, st_p.params)
+    assert _leaves_equal(st_i.residuals, st_p.residuals)
+    assert _leaves_equal(st_i.buffer, st_p.buffer)
+    assert np.asarray(m_p["pop_hist"]).shape == (1,)
+
+    # stream() is only a dispatch change under populations too
+    fs_s, st_s = _driver(_cfg(**_fed_kw(pop_spec=UNIFORM_SPEC, **kw)), mesh8)
+    st_s, hist, _ = fs_s.stream(st_s, key, 4)
+    assert len(hist) == 4
+    assert _leaves_equal(st_p.params, st_s.params)
+    assert _leaves_equal(st_p.buffer, st_s.buffer)
+
+
+def test_pop_hist_exact_mass_and_shares(mesh8):
+    """The per-class histogram is EXACT per-round accounting: its mass
+    equals the live-client count every round, and the cumulative shares
+    track the quota composition (0.75/0.25) once enough cohorts sample."""
+    key = jax.random.PRNGKey(2)
+    fs, st = _driver(_cfg(**_fed_kw(pop_spec=SKEW_SPEC)), mesh8)
+    total = np.zeros(2)
+    for r in range(6):
+        st, m = fs.step(st, jax.random.fold_in(key, r))
+        h = np.asarray(m["pop_hist"], dtype=np.float64)
+        assert h.shape == (2,) and np.all(h >= 0)
+        assert float(h.sum()) == float(m["clients"])
+        total += h
+    shares = total / total.sum()
+    np.testing.assert_allclose(shares, [0.75, 0.25], atol=0.15)
+    assert all(
+        bool(jnp.all(jnp.isfinite(x)))
+        for x in jax.tree_util.tree_leaves(st.params)
+    )
+
+
+def test_pop_per_class_latency_async(mesh8):
+    """Per-class latency rows drive the staleness draw: a population whose
+    slow class carries all its mass at tau=2 shows a staleness tail, and
+    the histogram still accounts every accepted contribution."""
+    spec = json.dumps({"version": 1, "classes": [
+        {"name": "fast", "weight": 1.0, "latency": "1"},
+        {"name": "slow", "weight": 1.0, "latency": "0,0,1"},
+    ]})
+    cfg = _cfg(**_fed_kw(fed_async=True, fed_async_k=16, pop_spec=spec))
+    key = jax.random.PRNGKey(4)
+    fs, st = _driver(cfg, mesh8)
+    saw_tail = False
+    for r in range(4):
+        st, m = fs.step(st, jax.random.fold_in(key, r))
+        h = np.asarray(m["pop_hist"], dtype=np.float64)
+        assert float(h.sum()) == float(m["clients"])
+        sh = np.asarray(m["staleness_hist"], dtype=np.float64)
+        assert sh.shape == (3,)  # D = per-class common depth
+        saw_tail = saw_tail or sh[2] > 0
+    # the slow class's deterministic tau=2 row produced a genuine tail
+    assert saw_tail
+
+
+# ---------------------------------------------------------------------- #
+# accumulator plumbing: the optional f32[K] child
+# ---------------------------------------------------------------------- #
+
+
+def test_metric_accumulators_pop_hist_vector():
+    from deepreduce_tpu.metrics import WireStats
+    from deepreduce_tpu.telemetry import MetricAccumulators
+    from deepreduce_tpu.telemetry.device_metrics import fetch_delta
+
+    wire = WireStats(
+        index_bits=jnp.asarray(10.0), value_bits=jnp.asarray(20.0),
+        dense_bits=jnp.asarray(100.0), saturated=jnp.asarray(0.0),
+    )
+    acc = MetricAccumulators.zeros(num_pop_classes=2)
+    assert acc.pop_hist is not None and acc.pop_hist.shape == (2,)
+    acc = acc.accumulate(wire, pop_hist=jnp.asarray([3.0, 1.0]))
+    acc = acc.accumulate(wire, pop_hist=jnp.asarray([1.0, 3.0]))
+    vals = acc.fetch()
+    assert vals["pop_hist"] == [4.0, 4.0]
+    d = MetricAccumulators.derive(vals)
+    assert d["pop_shares"] == [0.5, 0.5]
+    assert d["pop_residency_min"] == 0.5
+    # a window delta subtracts the histogram elementwise
+    acc2 = acc.accumulate(wire, pop_hist=jnp.asarray([2.0, 0.0]))
+    delta = fetch_delta(acc2.fetch(), vals)
+    assert delta["pop_hist"] == [2.0, 0.0]
+    with pytest.raises(ValueError, match="pop_hist length mismatch"):
+        fetch_delta(acc2.fetch(), vals | {"pop_hist": [1.0]})
+    # population-off accumulators are STRUCTURALLY unchanged: the None
+    # child contributes no pytree leaf and no fetched key
+    off = MetricAccumulators.zeros()
+    assert off.pop_hist is None
+    assert "pop_hist" not in off.fetch()
+    assert "pop_shares" not in MetricAccumulators.derive(off.fetch())
+    off2 = off.accumulate(wire)
+    assert off2.pop_hist is None
+    assert jax.tree_util.tree_structure(off) == jax.tree_util.tree_structure(
+        MetricAccumulators.zeros())
+
+
+# ---------------------------------------------------------------------- #
+# cost model: collapse-exact population pricing
+# ---------------------------------------------------------------------- #
+
+
+def test_costmodel_pop_compute_factor():
+    # uniform multipliers collapse to the EXACT literal 1.0 (no rounding)
+    assert cm.pop_compute_factor((0.3, 0.7), (1.0, 1.0)) == 1.0
+    assert cm.pop_compute_factor((3.0, 1.0), (1.0, 2.0)) == pytest.approx(1.25)
+    with pytest.raises(ValueError, match="class weights"):
+        cm.pop_compute_factor((1.0,), (1.0, 2.0))
+    with pytest.raises(ValueError, match="at least one class"):
+        cm.pop_compute_factor((), ())
+    with pytest.raises(ValueError, match="sum"):
+        cm.pop_compute_factor((0.0, 0.0), (1.0, 2.0))
+
+
+def test_costmodel_pop_staleness_and_throughput():
+    # mixture staleness: equal-weight tau=0 and tau=2 classes average to 1
+    rows = ((1.0, 0.0, 0.0), (0.0, 0.0, 1.0))
+    assert cm.pop_expected_staleness((1.0, 1.0), rows) == pytest.approx(1.0)
+    # uniform population prices EXACTLY like no population at all
+    assert cm.fed_pop_clients_per_sec(1000.0, 100, t_client_s=0.5) == \
+        cm.fed_clients_per_sec(1000.0, 100, t_client_s=0.5)
+    assert cm.fed_pop_async_clients_per_sec(1000.0, 100, t_client_s=0.5) == \
+        cm.fed_async_clients_per_sec(1000.0, 100, t_client_s=0.5)
+    # a heavier compute class slows the cohort barrier
+    slow = cm.fed_pop_clients_per_sec(
+        1000.0, 100, weights=(1.0, 1.0), local_steps_mults=(1.0, 4.0),
+        t_client_s=0.5)
+    assert slow < cm.fed_clients_per_sec(1000.0, 100, t_client_s=0.5)
+    # per-class latency rows stretch the async pipeline vs zero latency
+    base = cm.fed_pop_async_clients_per_sec(1.0, 10, t_client_s=4.0)
+    stale = cm.fed_pop_async_clients_per_sec(
+        1.0, 10, weights=(1.0, 1.0), local_steps_mults=(1.0, 1.0),
+        class_latency_rows=rows, t_client_s=4.0)
+    assert stale < base
+
+
+# ---------------------------------------------------------------------- #
+# SLO health plane: the pop_residency_min target
+# ---------------------------------------------------------------------- #
+
+
+def test_slo_pop_residency_spec_and_monitor():
+    from deepreduce_tpu.slo import HealthMonitor, SLOSpec
+
+    spec = SLOSpec.from_dict({"targets": {"pop_residency_min": 0.25}})
+    assert spec.targets["pop_residency_min"] == 0.25
+    for bad in (-0.1, 1.5):
+        with pytest.raises(ConfigError) as ei:
+            SLOSpec.from_dict({"targets": {"pop_residency_min": bad}})
+        assert reason_code_of(ei.value) == "slo-spec-target-range"
+
+    mon_spec = SLOSpec(window_ticks=1, fast_window_ticks=1,
+                       slow_window_ticks=1, hysteresis_ticks=1,
+                       targets={"pop_residency_min": 0.25})
+    # a starved class (share 0.1 < 0.25) breaches
+    mon = HealthMonitor(mon_spec)
+    for tick in range(2):
+        mon.observe(tick, {"pop_hist": [9.0, 1.0]})
+    assert mon.state_of() == "BREACH"
+    v = mon.verdict(0)["targets"]["pop_residency_min"]
+    assert v["value"] == pytest.approx(0.1) and not v["ok"]
+    # a balanced population holds
+    mon = HealthMonitor(mon_spec)
+    for tick in range(2):
+        mon.observe(tick, {"pop_hist": [5.0, 5.0]})
+    assert mon.state_of() == "OK" and mon.healthy()
+    # rows without a histogram carry no evidence: no transitions
+    mon = HealthMonitor(mon_spec)
+    for tick in range(4):
+        mon.observe(tick, {"clients": 16.0})
+    assert mon.events == [] and mon.healthy()
+    row = mon.verdict(0)["targets"]["pop_residency_min"]
+    assert row["value"] is None and row["ok"]
+
+
+# ---------------------------------------------------------------------- #
+# committed bench ledger: the r25 population convergence-band sweep
+# ---------------------------------------------------------------------- #
+
+
+def test_bench_pop_ledger_row_committed(capsys):
+    """BENCH_POP_r25.json must stay a valid modeled+measured ledger record
+    (bench-history renders it), and its convergence-band evidence must
+    hold: every skew arm inside the loss band, per-class shares summing
+    to one."""
+    from deepreduce_tpu.telemetry import __main__ as cli
+
+    root = pathlib.Path(cli.__file__).resolve().parents[2]
+    rec = json.loads((root / "BENCH_POP_r25.json").read_text())
+    assert rec["metric"] == "fedsim_pop_serving_clients_per_sec"
+    assert rec["provenance"]["modeled"] and rec["provenance"]["measured"]
+    detail = rec["detail"]
+    arms = detail["arms"]
+    assert set(arms) == {"uniform", "mild_skew", "pathological_skew"}
+    assert detail["all_arms_within_loss_band"]
+    assert all(detail["within_loss_band"].values())
+    for arm in arms.values():
+        shares = arm["pop_shares_measured"]
+        assert sum(shares) == pytest.approx(1.0, abs=1e-3)
+        assert len(shares) == arm["num_classes"]
+    assert arms["uniform"]["num_classes"] == 1
+    assert arms["pathological_skew"]["num_classes"] == 2
+
+    assert cli.main(["bench-history", str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "r25" in out

@@ -367,4 +367,5 @@ def test_schema_key_tables_are_consistent():
         "min_clients_per_round", "min_clients_per_sec",
         "staleness_p95_max", "buffer_fill_max", "checksum_failure_budget",
         "convergence_band", "convergence_residency_min",
+        "pop_residency_min",
     }
